@@ -1,0 +1,20 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of PaddlePaddle
+Fluid 1.5 (see SURVEY.md at the repo root for the capability map). The
+compute path is traced Python -> XLA HLO -> pjit/GSPMD over a device mesh;
+runtime services (data feeding, inference serving) are native C++.
+"""
+
+from paddle_tpu.version import __version__
+
+from paddle_tpu import config, core, io, nn, ops, optimizer
+from paddle_tpu.config import global_config, set_flags
+from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
+from paddle_tpu.executor import CompiledProgram, Executor, Program
+
+__all__ = [
+    "__version__", "config", "core", "io", "nn", "ops", "optimizer",
+    "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
+    "CompiledProgram", "Executor", "Program",
+]

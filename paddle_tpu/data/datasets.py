@@ -1,0 +1,86 @@
+"""Stock datasets (parity: ``python/paddle/dataset/`` — mnist, cifar, imdb,
+wmt14/16…). This environment has zero network egress, so these are
+*synthetic but learnable* generators with the same sample schemas as the
+reference loaders: models and tests exercise identical shapes/dtypes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_mnist(n=1024, seed=0, template_seed=0):
+    """(image[28,28,1] float32, label int64) — mnist schema.
+
+    Learnable structure: each class has a fixed random template (from
+    ``template_seed`` — keep it constant across train/eval splits); samples
+    are template + noise (from ``seed``), so a LeNet converges quickly.
+    """
+    rng = np.random.RandomState(template_seed)
+    templates = rng.randn(10, 28, 28, 1).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            label = r.randint(0, 10)
+            img = templates[label] + 0.3 * r.randn(28, 28, 1).astype(np.float32)
+            yield img.astype(np.float32), np.int64(label)
+
+    return reader
+
+
+def synthetic_imagenet(n=256, image_size=224, num_classes=1000, seed=0):
+    """(image[H,W,3] float32, label int64) — flowers/imagenet schema."""
+    rng = np.random.RandomState(seed)
+    means = rng.randn(num_classes, 1, 1, 3).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            label = r.randint(0, num_classes)
+            img = means[label] + r.randn(image_size, image_size, 3).astype(np.float32)
+            yield img.astype(np.float32), np.int64(label)
+
+    return reader
+
+
+def synthetic_lm(n=512, seq_len=128, vocab=1024, seed=0):
+    """(token_ids[L] int32,) — language-model schema (wmt/imdb analog).
+    Markov-chain structure so next-token prediction is learnable."""
+    rng = np.random.RandomState(seed)
+    # sparse transition preference: each token has 4 likely successors
+    succ = rng.randint(0, vocab, (vocab, 4))
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            ids = np.empty(seq_len, np.int32)
+            ids[0] = r.randint(0, vocab)
+            for t in range(1, seq_len):
+                if r.rand() < 0.8:
+                    ids[t] = succ[ids[t - 1], r.randint(0, 4)]
+                else:
+                    ids[t] = r.randint(0, vocab)
+            yield (ids,)
+
+    return reader
+
+
+def synthetic_ctr(n=2048, num_sparse_fields=26, num_dense=13,
+                  vocab_per_field=1000, seed=0):
+    """(dense[13] float32, sparse_ids[26] int64, label int64) — criteo/DeepFM
+    schema (reference ctr_reader / dist_ctr.py)."""
+    rng = np.random.RandomState(seed)
+    field_w = rng.randn(num_sparse_fields).astype(np.float32)
+    dense_w = rng.randn(num_dense).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            dense = r.randn(num_dense).astype(np.float32)
+            ids = r.randint(0, vocab_per_field, num_sparse_fields).astype(np.int64)
+            logit = dense @ dense_w / 4 + ((ids % 7 == 0) * field_w).sum()
+            label = np.int64(1 / (1 + np.exp(-logit)) > r.rand())
+            yield dense, ids, label
+
+    return reader

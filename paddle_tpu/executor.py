@@ -1,0 +1,126 @@
+"""Executor + Program: the run-a-model facade.
+
+Reference mapping:
+- ``Executor`` (``python/paddle/fluid/executor.py:418``, C++ hot loop
+  ``executor.cc:437``) interprets a ProgramDesc op-by-op. The TPU-native
+  equivalent compiles the whole step with XLA once and replays it:
+  :class:`Program` wraps a traced step function; :class:`Executor` feeds
+  host arrays, runs the compiled executable, fetches host results.
+- ``CompiledProgram.with_data_parallel`` (``compiler.py:138``) + the
+  AllReduce SSA-graph machinery → :meth:`Program.compile` with a mesh:
+  pjit/GSPMD shards the batch over ``(dp, fsdp)`` axes; gradient allreduce
+  is inserted by XLA, replacing AllReduceOpHandle (details/
+  all_reduce_op_handle.cc:127).
+- feed/fetch ops (``controlflow/feed_op.cc``) → named kwargs and returned
+  pytrees; no graph mutation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+
+
+@dataclasses.dataclass
+class Program:
+    """A step function + metadata; the ProgramDesc analog (serializable via
+    paddle_tpu.inference.export to StableHLO rather than protobuf).
+
+    ``fn(state, **feeds) -> (state, fetches)`` for train programs, or
+    ``fn(params, **feeds) -> fetches`` for inference; the Executor doesn't
+    care — it passes state through if the output is a 2-tuple with the same
+    structure.
+    """
+
+    fn: Callable
+    name: str = "program"
+    # Donate the state buffers to the compiled step (train programs should
+    # set True for in-place param updates; False is the safe default so an
+    # inference program can be called repeatedly with the same params).
+    donate_state: bool = False
+    # Sharding: feed arrays get batch sharding over (dp, fsdp) unless listed
+    # in `replicated_feeds`.
+    replicated_feeds: Sequence[str] = ()
+
+    def compile(self, mesh: Optional[Mesh] = None,
+                state_shardings: Any = None) -> "CompiledProgram":
+        return CompiledProgram(self, mesh, state_shardings)
+
+
+class CompiledProgram:
+    """jit/pjit-compiled program bound to a mesh (CompiledProgram parity)."""
+
+    def __init__(self, program: Program, mesh: Optional[Mesh] = None,
+                 state_shardings: Any = None):
+        self.program = program
+        self.mesh = mesh
+        self._batch_sharding = (mesh_lib.batch_sharding(mesh)
+                                if mesh is not None else None)
+        self._replicated = (mesh_lib.replicated(mesh)
+                            if mesh is not None else None)
+        donate = (0,) if program.donate_state else ()
+        if mesh is not None and state_shardings is not None:
+            in_shardings = (state_shardings,)
+            self._fn = jax.jit(program.fn, donate_argnums=donate,
+                               in_shardings=in_shardings)
+        else:
+            self._fn = jax.jit(program.fn, donate_argnums=donate)
+
+    def __call__(self, state, **feeds):
+        if self.mesh is not None:
+            feeds = {
+                k: jax.device_put(
+                    v, self._replicated
+                    if k in self.program.replicated_feeds
+                    else self._batch_sharding)
+                for k, v in feeds.items()
+            }
+        return self._fn(state, **feeds)
+
+
+class Executor:
+    """Feed/fetch runner (fluid Executor parity: run(program, feed, fetch)).
+
+    ``place`` is kept for API familiarity but is advisory — placement is the
+    mesh's job.
+    """
+
+    def __init__(self, place=None, mesh: Optional[Mesh] = None):
+        self.place = place
+        self.mesh = mesh
+        self._cache: Dict[int, tuple] = {}
+
+    def run(self, program, state=None, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[str]] = None, return_numpy=True):
+        """Run one step. ``fetch_list`` selects keys out of a dict result
+        (fluid fetch parity); None returns everything."""
+        feed = feed or {}
+        if isinstance(program, Program):
+            # Keyed by id but the cache holds a strong ref to the Program, so
+            # an address can't be recycled while its entry is alive.
+            key = id(program)
+            if key not in self._cache:
+                self._cache[key] = (program, program.compile(self.mesh))
+            cached_prog, compiled = self._cache[key]
+            assert cached_prog is program
+        else:
+            compiled = program
+        out = compiled(state, **feed)
+        if isinstance(out, tuple) and len(out) == 2:
+            state, fetches = out
+        else:
+            fetches = out
+        if fetch_list and isinstance(fetches, dict):
+            fetches = {k: fetches[k] for k in fetch_list}
+        if return_numpy:
+            fetches = jax.tree_util.tree_map(np.asarray, jax.device_get(fetches))
+        return state, fetches
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,7 @@
+"""Model zoo covering the BASELINE.json configs: LeNet (MNIST), ResNet-50,
+BERT-base, Transformer-big, DeepFM (reference model sources:
+``python/paddle/fluid/tests/book/`` + PaddleCV/PaddleNLP recipes)."""
+
+from paddle_tpu.models.lenet import LeNet
+
+__all__ = ["LeNet"]

@@ -1,0 +1,58 @@
+"""Broadcasted elementwise binary ops.
+
+Reference: ``paddle/fluid/operators/elementwise/`` (34 files, hand-rolled
+broadcast engine in ``elementwise_op_function.h``). On TPU the entire
+broadcast machinery is XLA's — these are thin registrations so the op
+surface, OpTest coverage, and ``axis``-style broadcasting parity exist.
+
+Fluid's ``axis`` attribute aligns y's dims starting at ``axis`` of x
+(e.g. x:[N,C,H,W], y:[C], axis=1). We reproduce that by reshaping y.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _align(x, y, axis):
+    """Expand y to x's rank with fluid's axis semantics."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    trailing = x.ndim - axis - y.ndim
+    if trailing < 0:
+        raise ValueError(f"bad axis {axis} for shapes {x.shape}, {y.shape}")
+    return y.reshape(y.shape + (1,) * trailing)
+
+
+def _np_align(x, y, axis):
+    x, y = np.asarray(x), np.asarray(y)
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    return y.reshape(y.shape + (1,) * (x.ndim - axis - y.ndim))
+
+
+def _make(name, fn, np_fn):
+    def ref(x, y, axis=-1):
+        return np_fn(x, _np_align(x, y, axis))
+
+    @register_op(f"elementwise_{name}", reference=ref)
+    def op(x, y, axis=-1):
+        return fn(x, _align(x, jnp.asarray(y), axis))
+
+    op.__name__ = f"elementwise_{name}"
+    op.__doc__ = f"Broadcasted elementwise {name} (fluid elementwise_{name}_op)."
+    return op
+
+
+add = _make("add", jnp.add, np.add)
+sub = _make("sub", jnp.subtract, np.subtract)
+mul = _make("mul", jnp.multiply, np.multiply)
+div = _make("div", jnp.divide, np.divide)
+floordiv = _make("floordiv", jnp.floor_divide, np.floor_divide)
+mod = _make("mod", jnp.mod, np.mod)
+max = _make("max", jnp.maximum, np.maximum)
+min = _make("min", jnp.minimum, np.minimum)
+pow = _make("pow", jnp.power, np.power)

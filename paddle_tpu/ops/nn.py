@@ -1,0 +1,367 @@
+"""Neural-net ops: conv, pooling, normalization, embedding, losses.
+
+Reference: fluid's cuDNN-backed kernels (``operators/conv_op.*``,
+``operators/conv_cudnn_op.cu.cc``, ``softmax_op``, ``layer_norm_op``,
+``batch_norm_op``, ``cross_entropy_op``, ``dropout_op``,
+``lookup_table_op``, ``operators/math/pooling.*``).
+
+TPU-first decisions:
+- Layout is NHWC (TPU conv-native); fluid's default is NCHW. ``data_format``
+  accepts both; internal compute is NHWC so XLA maps convs onto the MXU
+  without transposes.
+- Dropout takes an explicit PRNG ``key`` (functional; no global RNG state —
+  fluid threads a seed attribute through the op).
+- lookup_table's sparse-grad path (SelectedRows) is unnecessary: XLA
+  scatter-add handles embedding grads; beyond-HBM tables live in
+  paddle_tpu.parallel.embedding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+def _to_nhwc(x, data_format):
+    if data_format == "NCHW":
+        return jnp.transpose(x, (0, 2, 3, 1))
+    return x
+
+
+def _from_nhwc(x, data_format):
+    if data_format == "NCHW":
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@register_op("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC"):
+    """2-D convolution (fluid conv2d / cudnn conv -> XLA conv on MXU).
+
+    weight layout: HWIO (filter_h, filter_w, in_channels/groups, out_channels).
+    padding: int, pair, or "SAME"/"VALID".
+    """
+    x = _to_nhwc(x, data_format)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        ph, pw = _pair(padding)
+        pad = ((ph, ph), (pw, pw))
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias
+    return _from_nhwc(out, data_format)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     data_format="NHWC"):
+    """Transposed conv (fluid conv2d_transpose_op). weight: HWIO.
+
+    Fluid semantics: out = (H-1)*stride + k - 2*padding (deconv = gradient of
+    conv w.r.t. input). Implemented as input-dilated conv with explicit pads
+    k-1-p and a spatially-flipped kernel, which is exactly that gradient.
+    """
+    x = _to_nhwc(x, data_format)
+    sh, sw = _pair(stride)
+    kh, kw = weight.shape[0], weight.shape[1]
+    ph, pw = _pair(padding)
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(weight, (0, 1)),
+        window_strides=(1, 1),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        lhs_dilation=(sh, sw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias is not None:
+        out = out + bias
+    return _from_nhwc(out, data_format)
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NHWC"):
+    """Depthwise conv (fluid depthwise_conv2d, math/depthwise_conv.cu).
+    weight: HWI1 with groups == in_channels."""
+    channels = weight.shape[2]
+    w = weight.reshape(weight.shape[0], weight.shape[1], 1,
+                       channels * weight.shape[3])
+    return conv2d(x, w, bias, stride, padding, dilation, groups=channels,
+                  data_format=data_format)
+
+
+@register_op("pool2d")
+def pool2d(x, kernel=2, stride=None, padding=0, pool_type="max",
+           ceil_mode=False, data_format="NHWC", global_pooling=False):
+    """Max/avg pooling (fluid pool2d_op, operators/math/pooling.*)."""
+    x = _to_nhwc(x, data_format)
+    if global_pooling:
+        kernel = (x.shape[1], x.shape[2])
+        stride, padding = kernel, 0
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    dims = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    elif pool_type == "avg":
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        if ph == 0 and pw == 0:
+            out = summed / (kh * kw)
+        else:
+            # count_include_pad=False parity: divide by true window size
+            ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+            out = summed / counts
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return _from_nhwc(out, data_format)
+
+
+@register_op("adaptive_pool2d")
+def adaptive_pool2d(x, output_size, pool_type="avg", data_format="NHWC"):
+    x = _to_nhwc(x, data_format)
+    oh, ow = _pair(output_size)
+    n, h, w, c = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, oh, h // oh, ow, w // ow, c)
+        out = x.max(axis=(2, 4)) if pool_type == "max" else x.mean(axis=(2, 4))
+    else:
+        raise NotImplementedError("adaptive pool requires divisible sizes")
+    return _from_nhwc(out, data_format)
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+@register_op("softmax", reference=_np_softmax)
+def softmax(x, axis=-1):
+    """Numerically-stable softmax (fluid softmax_op / cudnn softmax)."""
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax", reference=lambda x, axis=-1: np.log(_np_softmax(x, axis)))
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def _np_layer_norm(x, scale=None, bias=None, epsilon=1e-5, begin_norm_axis=1):
+    axes = tuple(range(begin_norm_axis, np.ndim(x)))
+    mean = np.mean(x, axis=axes, keepdims=True)
+    var = np.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / np.sqrt(var + epsilon)
+    if scale is not None:
+        out = out * np.reshape(scale, x.shape[begin_norm_axis:])
+    if bias is not None:
+        out = out + np.reshape(bias, x.shape[begin_norm_axis:])
+    return out
+
+
+@register_op("layer_norm", reference=_np_layer_norm)
+def layer_norm(x, scale=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
+    """Layer normalization (fluid layer_norm_op; a Pallas fused variant lives
+    in paddle_tpu.ops.pallas.layer_norm for the hot path)."""
+    if begin_norm_axis < 0:
+        begin_norm_axis = x.ndim + begin_norm_axis
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if scale is not None:
+        out = out * scale.reshape(x.shape[begin_norm_axis:])
+    if bias is not None:
+        out = out + bias.reshape(x.shape[begin_norm_axis:])
+    return out
+
+
+@register_op("batch_norm")
+def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
+               training=False, data_format="NHWC"):
+    """Batch normalization (fluid batch_norm_op.cc).
+
+    Returns (out, new_mean, new_variance). In inference mode the running
+    stats pass through unchanged. Channel dim is last for NHWC, 1 for NCHW.
+    """
+    caxis = 1 if data_format == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    if training:
+        batch_mean = jnp.mean(x, axis=axes)
+        batch_var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1 - momentum) * batch_mean
+        new_var = momentum * variance + (1 - momentum) * batch_var
+        use_mean, use_var = batch_mean, batch_var
+    else:
+        new_mean, new_var = mean, variance
+        use_mean, use_var = mean, variance
+    inv = jax.lax.rsqrt(use_var + epsilon) * scale
+    out = (x - use_mean.reshape(shape)) * inv.reshape(shape) + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+@register_op("dropout")
+def dropout(x, key, rate=0.5, training=True):
+    """Dropout with explicit PRNG key (fluid dropout_op; upscale_in_train)."""
+    if not training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_op("lookup_table", has_grad=True)
+def embedding(ids, table, padding_idx=None):
+    """Embedding lookup (fluid lookup_table_op). Grad is an XLA scatter-add;
+    the reference's SelectedRows sparse-grad machinery is unneeded."""
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+@register_op("one_hot", has_grad=False,
+             reference=lambda ids, depth: np.eye(depth)[np.asarray(ids)])
+def one_hot(ids, depth):
+    return jax.nn.one_hot(ids, depth)
+
+
+# -- losses ----------------------------------------------------------------
+
+def _np_cross_entropy(logp_or_probs, label, soft_label=False):
+    x = np.asarray(logp_or_probs)
+    if soft_label:
+        return -np.sum(label * np.log(x), axis=-1, keepdims=True)
+    lbl = np.asarray(label).reshape(-1)
+    flat = x.reshape(-1, x.shape[-1])
+    picked = flat[np.arange(flat.shape[0]), lbl]
+    return -np.log(picked).reshape(x.shape[:-1] + (1,))
+
+
+@register_op("cross_entropy", reference=_np_cross_entropy)
+def cross_entropy(probs, label, soft_label=False, epsilon=1e-12):
+    """CE over probabilities (fluid cross_entropy_op; pair with softmax)."""
+    logp = jnp.log(jnp.clip(probs, epsilon, 1.0))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=-1, keepdims=True)
+    lbl = label.astype(jnp.int32)
+    if lbl.ndim == probs.ndim:  # fluid (N, 1) hard-label convention
+        lbl = lbl.squeeze(-1)
+    picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+    return -picked
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               return_softmax=False, ignore_index=None):
+    """Fused softmax+CE (fluid softmax_with_cross_entropy_op.cu — the fused
+    CUDA kernel; on TPU XLA fuses logsumexp+gather into one pass)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == logits.ndim:
+            lbl = lbl.squeeze(-1)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+        loss = -picked
+        if ignore_index is not None:
+            loss = jnp.where(lbl[..., None] == ignore_index, 0.0, loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(x, label):
+    """max(x,0) - x*z + log(1+exp(-|x|)) (fluid op of the same name)."""
+    return jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("square_error_cost",
+             reference=lambda x, y: np.square(np.asarray(x) - np.asarray(y)))
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@register_op("smooth_l1", reference=None)
+def smooth_l1(x, y, sigma=1.0):
+    diff = jnp.abs(x - y)
+    s2 = sigma * sigma
+    return jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff, diff - 0.5 / s2)
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(x, target, reduction="mean"):
+    loss = target * (jnp.log(jnp.clip(target, 1e-12)) - x)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(label, left, right, margin=0.1):
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+@register_op("huber_loss")
+def huber_loss(input, label, delta=1.0):
+    diff = jnp.abs(label - input)
+    return jnp.where(diff <= delta, 0.5 * diff * diff,
+                     delta * (diff - 0.5 * delta))
+
+
+# -- misc nn ---------------------------------------------------------------
+
+@register_op("label_smooth")
+def label_smooth(label, epsilon=0.1):
+    k = label.shape[-1]
+    return label * (1 - epsilon) + epsilon / k
+
+
+@register_op("pad", reference=lambda x, paddings, pad_value=0.0:
+             np.pad(x, paddings, constant_values=pad_value))
+def pad(x, paddings, pad_value=0.0):
+    return jnp.pad(x, paddings, constant_values=pad_value)
+
+
+@register_op("interpolate", has_grad=True)
+def interpolate(x, size, method="nearest", data_format="NHWC"):
+    """Image resize (fluid interpolate/image_resize ops)."""
+    x = _to_nhwc(x, data_format)
+    oh, ow = _pair(size)
+    out = jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]), method=method)
+    return _from_nhwc(out, data_format)
+
+
+@register_op("grid_sampler", has_grad=False)
+def grid_sampler(x, grid):
+    raise NotImplementedError("grid_sampler pending (detection family)")

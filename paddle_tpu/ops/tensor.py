@@ -1,0 +1,247 @@
+"""Tensor manipulation ops (reference: fluid's concat/split/reshape/transpose/
+gather/scatter/top_k/argsort/cast/fill/assign op families in
+``paddle/fluid/operators/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.dtypes import convert_dtype
+
+
+@register_op("concat", reference=lambda xs, axis=0: np.concatenate(xs, axis))
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0):
+    """fluid split_op: int -> equal parts; list -> section sizes."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    bounds = np.cumsum(num_or_sections)[:-1].tolist()
+    return jnp.split(x, bounds, axis=axis)
+
+
+@register_op("stack", reference=lambda xs, axis=0: np.stack(xs, axis))
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("unstack", has_grad=True)
+def unstack(x, axis=0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+
+
+@register_op("reshape", reference=lambda x, shape: np.reshape(x, shape))
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register_op("squeeze", reference=lambda x, axes=None: np.squeeze(x, tuple(axes) if axes else None))
+def squeeze(x, axes=None):
+    return jnp.squeeze(x, tuple(axes) if axes else None)
+
+
+@register_op("unsqueeze", reference=lambda x, axes: np.expand_dims(x, tuple(axes) if isinstance(axes, (list, tuple)) else axes))
+def unsqueeze(x, axes):
+    return jnp.expand_dims(x, tuple(axes) if isinstance(axes, (list, tuple)) else axes)
+
+
+@register_op("flatten")
+def flatten(x, axis=1):
+    """fluid flatten_op: collapse dims before/after ``axis`` into a matrix."""
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("transpose", reference=lambda x, perm: np.transpose(x, perm))
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+import builtins
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends):  # noqa: A001 - fluid op name
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@register_op("gather", reference=lambda x, index: np.take(x, index, 0))
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    """fluid scatter_op: write rows of ``updates`` at ``index``."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@register_op("top_k", has_grad=False)
+def top_k(x, k):
+    return jax.lax.top_k(x, k)
+
+
+@register_op("argsort", has_grad=False,
+             reference=lambda x, axis=-1: (np.sort(x, axis), np.argsort(x, axis, kind="stable")))
+def argsort(x, axis=-1):
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    return jnp.take_along_axis(x, idx, axis=axis), idx
+
+
+@register_op("argmax", has_grad=False, reference=lambda x, axis=-1: np.argmax(x, axis))
+def argmax(x, axis=-1):
+    return jnp.argmax(x, axis=axis)
+
+
+@register_op("argmin", has_grad=False, reference=lambda x, axis=-1: np.argmin(x, axis))
+def argmin(x, axis=-1):
+    return jnp.argmin(x, axis=axis)
+
+
+@register_op("cast", reference=lambda x, dtype: np.asarray(x).astype(dtype))
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("fill_constant", has_grad=False)
+def fill_constant(shape, dtype, value):
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+@register_op("zeros_like", has_grad=False, reference=np.zeros_like)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like", has_grad=False, reference=np.ones_like)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("assign", reference=np.asarray)
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("expand", reference=lambda x, times: np.tile(x, times))
+def expand(x, expand_times):
+    return jnp.tile(x, expand_times)
+
+
+@register_op("expand_as")
+def expand_as(x, target):
+    return jnp.broadcast_to(x, target.shape)
+
+
+@register_op("tile", reference=np.tile)
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+@register_op("where", reference=np.where)
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register_op("masked_select", has_grad=False)
+def masked_select(x, mask, size=None):
+    """Static-shape variant: requires ``size`` (XLA has no dynamic output
+    shapes); pads with zeros. fluid's masked_select is dynamic."""
+    if size is None:
+        raise ValueError("TPU masked_select needs a static `size`")
+    idx = jnp.nonzero(mask.reshape(-1), size=size, fill_value=0)[0]
+    return x.reshape(-1)[idx]
+
+
+@register_op("range", has_grad=False, reference=lambda s, e, st: np.arange(s, e, st))
+def arange(start, end, step=1, dtype=jnp.int32):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+@register_op("linspace", has_grad=False)
+def linspace(start, stop, num, dtype=jnp.float32):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+@register_op("shape", has_grad=False)
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register_op("eye", has_grad=False)
+def eye(num_rows, num_cols=None, dtype=jnp.float32):
+    return jnp.eye(num_rows, num_cols, dtype=convert_dtype(dtype))
+
+
+@register_op("diag", has_grad=False)
+def diag(x):
+    return jnp.diag(x)
+
+
+@register_op("flip", reference=lambda x, axis: np.flip(x, axis))
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@register_op("isfinite", has_grad=False, reference=np.isfinite)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register_op("isnan", has_grad=False, reference=np.isnan)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("increment")
+def increment(x, value=1.0):
+    return x + value
+
+
+@register_op("accuracy", has_grad=False)
+def accuracy(logits_or_topk, label, k=1):
+    """fluid accuracy_op (operators/metrics/accuracy_op)."""
+    _, pred = jax.lax.top_k(logits_or_topk, k)
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(pred == lbl, axis=1)
+    return jnp.mean(correct.astype(jnp.float32))

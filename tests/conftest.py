@@ -1,0 +1,32 @@
+"""Test fixture: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's distributed-without-a-cluster strategy
+(test_dist_base.py spawns localhost subprocesses); here XLA's virtual CPU
+devices give us 8 devices in-process, so multi-chip sharding paths compile
+and execute exactly as they would on a v5e-8 slice.
+
+Note: this environment's sitecustomize imports jax at interpreter start (TPU
+plugin registration), so env-var-based platform selection is too late here —
+we use jax.config.update, which works until the first backend use.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """8-device DP mesh."""
+    from paddle_tpu.core.mesh import MeshConfig, make_mesh
+    return make_mesh(MeshConfig(dp=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_dp2_tp4():
+    from paddle_tpu.core.mesh import MeshConfig, make_mesh
+    return make_mesh(MeshConfig(dp=2, tp=4))

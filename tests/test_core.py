@@ -1,0 +1,115 @@
+"""Core framework tests: dtypes, mesh, registry, module system, config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import dtypes, mesh as mesh_lib
+from paddle_tpu.core.registry import get_op, list_ops
+from paddle_tpu.nn import (BatchNorm, Layer, Linear, Sequential,
+                           apply_state_updates, capture_state)
+
+
+def test_convert_dtype():
+    assert dtypes.convert_dtype("float32") == jnp.float32
+    assert dtypes.convert_dtype("bfloat16") == jnp.bfloat16
+    with pytest.raises(ValueError):
+        dtypes.convert_dtype("nope")
+
+
+def test_policy_cast():
+    p = dtypes.get_policy("bf16")
+    tree = {"w": jnp.ones((2, 2)), "i": jnp.ones((2,), jnp.int32)}
+    out = p.cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32  # ints untouched
+
+
+def test_mesh_axes(mesh8):
+    assert mesh8.shape["dp"] == 8
+    assert set(mesh8.axis_names) == set(mesh_lib.ALL_AXES)
+
+
+def test_mesh_config_validation():
+    with pytest.raises(ValueError):
+        mesh_lib.make_mesh(mesh_lib.MeshConfig(dp=3))  # 3 doesn't divide 8
+
+
+def test_registry_has_core_ops():
+    ops = list_ops()
+    for name in ["matmul", "softmax", "layer_norm", "conv2d", "reduce_sum",
+                 "elementwise_add", "lookup_table", "dropout"]:
+        assert name in ops, name
+    info = get_op("softmax")
+    assert info.fn is not None
+
+
+def test_layer_param_tree():
+    class Net(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = Linear(4, 8)
+            self.fc2 = Linear(8, 2)
+
+        def forward(self, params, x):
+            return self.fc2(params["fc2"], jax.nn.relu(self.fc1(params["fc1"], x)))
+
+    net = Net()
+    params = net.init(jax.random.PRNGKey(0))
+    assert params["fc1"]["weight"].shape == (4, 8)
+    assert params["fc2"]["bias"].shape == (2,)
+    out = net(params, jnp.ones((3, 4)))
+    assert out.shape == (3, 2)
+    # jit + grad transform cleanly
+    loss = lambda p, x: net(p, x).sum()
+    g = jax.jit(jax.grad(loss))(params, jnp.ones((3, 4)))
+    assert g["fc1"]["weight"].shape == (4, 8)
+
+
+def test_init_deterministic():
+    net = Linear(4, 4)
+    p1 = net.init(jax.random.PRNGKey(7))
+    p2 = net.init(jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(p1["weight"]), np.asarray(p2["weight"]))
+
+
+def test_batchnorm_state_tape():
+    bn = BatchNorm(3)
+    bn._assign_paths(())
+    params = bn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 5, 5, 3)) * 2 + 1.0
+
+    with capture_state() as tape:
+        out = bn(params, x, training=True)
+    assert out.shape == x.shape
+    new_params = apply_state_updates(params, tape)
+    # running mean moved toward batch mean (momentum 0.9)
+    assert not np.allclose(np.asarray(new_params["mean"]),
+                           np.asarray(params["mean"]))
+    # normalized output ~ zero mean unit var per channel
+    np.testing.assert_allclose(np.asarray(out).mean(axis=(0, 1, 2)),
+                               np.zeros(3), atol=1e-4)
+
+
+def test_trainable_mask():
+    bn = BatchNorm(3)
+    params = bn.init(jax.random.PRNGKey(0))
+    mask = bn.trainable_mask(params)
+    assert mask["scale"] is True and mask["mean"] is False
+
+
+def test_sequential():
+    net = Sequential(Linear(4, 8), Linear(8, 2))
+    params = net.init(jax.random.PRNGKey(0))
+    out = net(params, jnp.ones((1, 4)))
+    assert out.shape == (1, 2)
+
+
+def test_config_flags():
+    pt.set_flags(check_nan_inf=True)
+    assert pt.global_config().execution.check_nan_inf is True
+    pt.set_flags(check_nan_inf=False)
+    with pytest.raises(ValueError):
+        pt.set_flags(not_a_flag=1)
