@@ -1,0 +1,19 @@
+"""Parallelism subsystem: sharding plans, pjit wrappers, explicit collectives.
+
+Replaces the reference's multi-device world — ParallelExecutor + SSA graph
+builders + NCCL op handles + DistributeTranspiler (SURVEY.md §2.6, §3.2) —
+with mesh-and-sharding declarations compiled by XLA GSPMD.
+"""
+
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.api import (shard_eval_step, shard_train_step,
+                                     with_sharding_constraint)
+from paddle_tpu.parallel.plan import (Rule, ShardingPlan, fsdp_plan,
+                                      megatron_plan, named_shardings,
+                                      replicated_plan)
+
+__all__ = [
+    "collective", "shard_eval_step", "shard_train_step",
+    "with_sharding_constraint", "Rule", "ShardingPlan", "fsdp_plan",
+    "megatron_plan", "named_shardings", "replicated_plan",
+]
