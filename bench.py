@@ -1,0 +1,123 @@
+"""Benchmark: BERT-base pretraining throughput on the attached device.
+
+Prints ONE JSON line:
+  {"metric": "bert_base_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": MFU/0.35, ...}
+
+The baseline is the driver-set north star (BASELINE.json): BERT-base at
+>=35% MFU. ``vs_baseline`` therefore reports achieved-MFU / 0.35 so that
+1.0 == target met. MFU uses the standard 6N + 12*L*S*d transformer
+FLOPs-per-token estimate against the device's peak matmul FLOPs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# peak bf16 matmul FLOPs per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def device_peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    if dev.platform == "tpu":
+        return 197e12  # conservative default: v5e-class
+    return 1e12  # CPU smoke-run placeholder
+
+
+def count_params(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def main():
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.train import build_train_step, make_train_state
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    cfg = BertConfig.base(dropout=0.0, attn_dropout=0.0)
+    seq = 512
+    batch_size = 8 if on_tpu else 2
+    steps = 20 if on_tpu else 3
+    if not on_tpu:  # CPU smoke config: keep the same code path, tiny model
+        cfg = BertConfig.tiny(dropout=0.0, attn_dropout=0.0, attn_impl="xla")
+        seq = 64
+
+    model = BertForPretraining(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4)
+    state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+    def loss_fn(params, **batch):
+        return model.loss(params, training=False, **batch)
+
+    policy = dtypes.get_policy("bf16") if on_tpu else None
+    step = jax.jit(build_train_step(loss_fn, optimizer, policy=policy),
+                   donate_argnums=(0,))
+
+    key = jax.random.PRNGKey(1)
+    batch = dict(
+        input_ids=jax.random.randint(key, (batch_size, seq), 0,
+                                     cfg.vocab_size, jnp.int32),
+        token_type_ids=jnp.zeros((batch_size, seq), jnp.int32),
+        attention_mask=jnp.ones((batch_size, seq), bool),
+        mlm_labels=jax.random.randint(key, (batch_size, seq), 0,
+                                      cfg.vocab_size, jnp.int32),
+        mlm_mask=(jax.random.uniform(key, (batch_size, seq)) < 0.15
+                  ).astype(jnp.float32),
+        nsp_labels=jnp.zeros((batch_size,), jnp.int32),
+    )
+
+    # warmup (compile). Sync via host transfer of the loss — NOT
+    # block_until_ready, which does not wait through proxied-device
+    # transports (observed on the axon TPU tunnel).
+    state, metrics = step(state, **batch)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, **batch)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    n_params = count_params(state["params"])
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * cfg.hidden_size
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / device_peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "batch_size": batch_size,
+        "seq_len": seq,
+        "params": n_params,
+        "loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

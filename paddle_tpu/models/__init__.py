@@ -3,5 +3,6 @@ BERT-base, Transformer-big, DeepFM (reference model sources:
 ``python/paddle/fluid/tests/book/`` + PaddleCV/PaddleNLP recipes)."""
 
 from paddle_tpu.models.lenet import LeNet
+from paddle_tpu.models.bert import (BertConfig, BertModel, BertForPretraining)
 
-__all__ = ["LeNet"]
+__all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining"]

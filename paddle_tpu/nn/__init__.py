@@ -6,10 +6,15 @@ from paddle_tpu.nn.module import (Layer, LayerList, ParamSpec, Sequential,
                                   report_state)
 from paddle_tpu.nn.layers import (FC, BatchNorm, Conv2D, Dropout, Embedding,
                                   LayerNorm, Linear, Pool2D)
+from paddle_tpu.nn.transformer import (FeedForward, MultiHeadAttention,
+                                       TransformerDecoderLayer,
+                                       TransformerEncoderLayer)
 
 __all__ = [
     "initializer", "Layer", "LayerList", "ParamSpec", "Sequential",
     "apply_state_updates", "capture_state", "report_state",
     "FC", "BatchNorm", "Conv2D", "Dropout", "Embedding", "LayerNorm",
     "Linear", "Pool2D",
+    "FeedForward", "MultiHeadAttention", "TransformerDecoderLayer",
+    "TransformerEncoderLayer",
 ]

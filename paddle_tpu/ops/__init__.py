@@ -5,8 +5,11 @@ Importing this package registers every op into the OpInfoMap
 pattern (op_registry.h:199) without global constructors.
 """
 
-from paddle_tpu.ops import (activation, elementwise, math, nn, reduction,
-                            tensor)
+from paddle_tpu.ops import (activation, attention, elementwise, math, nn,
+                            reduction, tensor)
+from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
+                                      flash_attention,
+                                      scaled_dot_product_attention)
 from paddle_tpu.ops.activation import *  # noqa: F401,F403
 from paddle_tpu.ops.elementwise import add, div, max, min, mod, mul as multiply, pow as elementwise_pow, sub  # noqa: F401
 from paddle_tpu.ops.math import bmm, dot, fc, matmul, mul  # noqa: F401

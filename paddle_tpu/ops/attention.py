@@ -1,0 +1,306 @@
+"""Attention ops: XLA-composed SDPA + Pallas flash-attention TPU kernel.
+
+Reference mapping: the reference has no fused attention — attention exists
+only as composed ops (mul/matmul + softmax + dropout) inside models and the
+``operators/fused/`` kernel fusions (SURVEY.md §2.3, §5.7). On TPU the hot
+path is a Pallas flash-attention kernel (online softmax, O(S) memory, MXU
+tiled) — the analog of the reference's ``fused/`` op family, designed for
+the MXU rather than translated.
+
+Layout convention: (batch, num_heads, seq, head_dim) — "BHSD".
+
+Dispatch: :func:`dot_product_attention` picks the Pallas kernel on TPU and
+the XLA-composed path elsewhere (CPU tests run the kernel in interpret
+mode). The Pallas forward carries a custom_vjp whose backward recomputes
+attention with the XLA path — correct grads, flash-speed forward; a full
+Pallas backward is a perf follow-up.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas backend; present in jax>=0.4 installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+                 # for fully-masked rows (padded queries)
+
+
+# ---------------------------------------------------------------------------
+# XLA-composed reference path
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(q, k, v, *, bias=None, causal=False,
+                                 scale: Optional[float] = None,
+                                 dropout_rate: float = 0.0,
+                                 dropout_key=None):
+    """Composed attention in fp32 softmax. q,k,v: (B, H, S, D).
+
+    ``bias`` is additive, broadcastable to (B, H, Sq, Sk) (use NEG_INF for
+    masked positions). ``causal`` adds a lower-triangular mask.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def make_padding_bias(pad_mask, dtype=jnp.float32):
+    """(B, Sk) bool valid-mask -> additive bias (B, 1, 1, Sk)."""
+    return jnp.where(pad_mask, 0.0, NEG_INF).astype(dtype)[:, None, None, :]
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *,
+                      scale, causal, block_q, block_k, seq_q, seq_k):
+    """Grid (BH, nq, nk); online-softmax accumulation over kv blocks.
+
+    Scratch: m (bq,128) running max, l (bq,128) running denom (values
+    broadcast across lanes), acc (bq, D) fp32 accumulator.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)           # (bq, D)
+        k = k_ref[0].astype(jnp.float32)           # (bk, D)
+        # zero padded kv rows (pallas pads out-of-bounds blocks with
+        # garbage/NaN; 0*NaN would poison the p@v contraction)
+        kv_valid = (ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < seq_k
+        k = jnp.where(kv_valid, k, 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(col <= row + (seq_k - seq_q), s, NEG_INF)
+        # mask out padding blocks past the true seq end (grid is padded up)
+        s = jnp.where(col < seq_k, s, NEG_INF)
+
+        m_prev = m_scr[...]                        # (bq, 128)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_cur)        # broadcast over lanes
+        alpha = jnp.exp(m_prev - m_next)           # (bq, 128)
+        p = jnp.exp(s - m_next[:, :1])             # (bq, bk)
+        l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+        v = jnp.where(kv_valid, v_ref[0].astype(jnp.float32), 0.0)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, D)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
+
+    if causal:
+        # skip kv blocks fully above the diagonal
+        below = ki * block_k <= qi * block_q + (block_q - 1) + (seq_k - seq_q)
+        pl.when(below)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = l_scr[...][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows -> 0
+        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, bias, *, scale, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        # key-only bias (B,1,1,Sk) or (1,1,1,Sk): broadcast rows over bq
+        bias = jnp.broadcast_to(bias, (b, h, sq, sk)) \
+            if bias.shape[2] not in (1,) else bias
+        if bias.shape[2] == 1:
+            br = jnp.broadcast_to(bias, (b, h, 1, sk)).reshape(bh, 1, sk)
+            br = jnp.broadcast_to(br[:, 0:1, :], (bh, 8, sk))  # sublane pad
+            in_specs.append(
+                pl.BlockSpec((1, 8, bk), lambda g, i, j: (g, 0, j)))
+            # kernel reads bias_ref[0] of shape (8, bk); slice row 0
+            args.append(br)
+            bias_mode = "key"
+        else:
+            br = bias.reshape(bh, sq, sk)
+            in_specs.append(
+                pl.BlockSpec((1, bq, bk), lambda g, i, j: (g, i, j)))
+            args.append(br)
+            bias_mode = "full"
+    else:
+        bias_mode = None
+
+    kernel = functools.partial(
+        _flash_kernel_dispatch, bias_mode=bias_mode, scale=scale,
+        causal=causal, block_q=bq, block_k=bk, seq_q=sq, seq_k=sk)
+
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ] if pltpu is not None else [
+        pl.ANY  # pragma: no cover
+    ]
+    grid = (bh, nq, nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if (pltpu is not None and not interpret) else None,
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, sq, d)
+
+
+def _flash_kernel_dispatch(*refs, bias_mode, **kw):
+    if bias_mode is None:
+        q_ref, k_ref, v_ref, o_ref, m, l, acc = refs
+        _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, m, l, acc, **kw)
+    elif bias_mode == "key":
+        q_ref, k_ref, v_ref, b_ref, o_ref, m, l, acc = refs
+        _flash_fwd_kernel(q_ref, k_ref, v_ref, _KeyBias(b_ref), o_ref,
+                          m, l, acc, **kw)
+    else:
+        q_ref, k_ref, v_ref, b_ref, o_ref, m, l, acc = refs
+        _flash_fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, m, l, acc, **kw)
+
+
+class _KeyBias:
+    """Adapts a (1, 8, bk) key-bias block to the (bq, bk) read the kernel
+    does: row 0 broadcast over queries."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def __getitem__(self, idx):
+        return self._ref[0][0:1, :]  # (1, bk), broadcasts against (bq, bk)
+
+    def astype(self, dt):  # pragma: no cover - not used
+        raise TypeError
+
+
+# ---------------------------------------------------------------------------
+# public flash_attention with custom_vjp
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(q, k, v, bias=None, causal=False,
+                    scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: bool = False):
+    """Flash attention (Pallas fwd). q,k,v: (B,H,S,D); bias additive,
+    broadcastable to (B,H,Sq,Sk). Backward recomputes via the XLA path."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None and bias.ndim < 4:  # accept broadcastable ranks
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    return _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, bias, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, bias)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+
+    def ref(q, k, v, bias):
+        return scaled_dot_product_attention(q, k, v, bias=bias, causal=causal,
+                                            scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v, bias)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def dot_product_attention(q, k, v, *, bias=None, causal=False,
+                          scale=None, dropout_rate=0.0, dropout_key=None,
+                          impl: str = "auto"):
+    """Attention entry point used by nn layers.
+
+    impl: "auto" (flash on TPU, xla elsewhere), "flash", "xla",
+    "flash_interpret" (tests).
+    """
+    if impl == "auto":
+        impl = "flash" if (_on_tpu() and dropout_rate == 0.0) else "xla"
+    if impl == "xla" or dropout_rate > 0.0:
+        return scaled_dot_product_attention(
+            q, k, v, bias=bias, causal=causal, scale=scale,
+            dropout_rate=dropout_rate, dropout_key=dropout_key)
+    interpret = impl == "flash_interpret"
+    return flash_attention(q, k, v, bias, causal, scale, 512, 512, interpret)

@@ -27,6 +27,30 @@ from paddle_tpu.core import mesh as mesh_lib
 from paddle_tpu.parallel import plan as plan_lib
 
 
+def batch_specs(batch: Any, *, seq_dim: Optional[int] = None) -> Any:
+    """Per-leaf PartitionSpecs for a feed dict: dim 0 over (dp, fsdp); with
+    ``seq_dim`` set, that dim of rank>=2 float/int arrays over "sp"
+    (sequence parallelism). Rank-0/1 leaves shard only the batch dim."""
+
+    def spec(x):
+        ndim = getattr(x, "ndim", 0)
+        if ndim == 0:
+            return P()
+        entries = [mesh_lib.BATCH_AXES] + [None] * (ndim - 1)
+        if seq_dim is not None and ndim > seq_dim:
+            entries[seq_dim] = "sp"
+        return P(*entries)
+
+    return jax.tree_util.tree_map(spec, batch)
+
+
+def _to_shardings(mesh: Mesh, spec: Any) -> Any:
+    """P-or-pytree-of-P -> NamedSharding pytree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec,
+        is_leaf=lambda x: isinstance(x, P))
+
+
 def shard_train_step(
     step: Callable,
     mesh: Mesh,
@@ -47,7 +71,7 @@ def shard_train_step(
     plan = plan or plan_lib.replicated_plan()
     state_specs = plan.state_specs(state, hints)
     state_sh = plan_lib.named_shardings(mesh, state_specs)
-    batch_sh = NamedSharding(mesh, batch_spec)
+    batch_sh = _to_shardings(mesh, batch_spec)
 
     def kw_step(state, batch):
         return step(state, **batch)
@@ -82,7 +106,7 @@ def shard_eval_step(
     plan = plan or plan_lib.replicated_plan()
     pspecs = plan.params_specs(params, hints)
     p_sh = plan_lib.named_shardings(mesh, pspecs)
-    batch_sh = NamedSharding(mesh, batch_spec)
+    batch_sh = _to_shardings(mesh, batch_spec)
 
     def kw_fn(params, batch):
         return fn(params, **batch)

@@ -15,6 +15,10 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)
+# numeric-parity tests compare kernels against numpy in true float32; the
+# backend's "default" matmul precision is bf16-class and would drown the
+# comparison in ~1e-3 noise
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
