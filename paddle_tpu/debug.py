@@ -1,0 +1,51 @@
+"""Numeric debugging: NaN/Inf checks, determinism knobs.
+
+Reference mapping (SURVEY.md §5.2): ``FLAGS_check_nan_inf`` validates every
+op output (operator.cc:35,840), ``FLAGS_fast_check_nan_inf`` (operator.cc:37)
+is the cheap variant, ``FLAGS_cpu_deterministic``/``cudnn_deterministic``
+pin reductions. TPU-native:
+- :func:`enable_nan_checks` → ``jax.debug_nans`` (XLA re-runs the failing
+  computation op-by-op and points at the op — better than the reference's
+  per-op scan, same contract).
+- :func:`check_numerics` → explicit in-graph assertion via checkify for
+  always-on production guards (fast_check_nan_inf analog).
+- determinism: XLA on TPU is deterministic by construction; dropout keys
+  are explicit, so there is no cudnn_deterministic analog needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def enable_nan_checks(enable: bool = True):
+    """Global NaN trap (FLAGS_check_nan_inf parity)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+def check_numerics(tree: Any, label: str = "tensor") -> Any:
+    """In-graph guard: error (under checkify) if any leaf has NaN/Inf.
+    Returns the tree unchanged, so it can be inserted mid-computation."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            name = label + jax.tree_util.keystr(path)
+            checkify.check(jnp.all(jnp.isfinite(leaf)),
+                           "non-finite values in {}".format(name))
+    return tree
+
+
+def checked(fn):
+    """Wrap a jittable fn so checkify.check assertions become returned
+    errors: ``err, out = checked(step)(...)``; ``err.throw()`` raises."""
+    return checkify.checkify(fn)
+
+
+def finite_or_zero(x):
+    """Scrub non-finite values (grad-scrubbing util for AMP overflow
+    handling — the reference's loss-scaling path skips steps instead)."""
+    return jnp.where(jnp.isfinite(x), x, 0.0)

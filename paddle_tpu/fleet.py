@@ -1,0 +1,107 @@
+"""Fleet: multi-host training bootstrap + role management.
+
+Reference mapping (SURVEY.md §2.6): the ``Fleet`` facade
+(``incubate/fleet/base/fleet_base.py:38`` init/init_worker/init_server),
+role makers (``role_maker.py`` — ``PaddleCloudRoleMaker:328`` reads
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env vars; ``MPISymetricRoleMaker``)
+and the nccl-id bootstrap (``c_gen_nccl_id_op.cc`` socket exchange).
+
+TPU-native: there are no pserver/trainer roles — every host is a worker in
+one SPMD program. Bootstrap is ``jax.distributed.initialize`` (the JAX
+coordination service replaces the nccl-id exchange); role queries map to
+process_index/process_count; ``DistributedStrategy`` becomes the typed
+(MeshConfig, ShardingPlan, Policy) triple.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class RoleMaker:
+    """Resolved distributed identity (role_maker.py parity, minus
+    pserver roles)."""
+
+    worker_index: int = 0
+    worker_num: int = 1
+    coordinator: Optional[str] = None
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index == 0
+
+    @classmethod
+    def from_env(cls) -> "RoleMaker":
+        """PaddleCloud-style env bootstrap (PADDLE_* honored for parity;
+        JAX_* / TPU pod env preferred)."""
+        idx = int(os.environ.get("JAX_PROCESS_INDEX",
+                                 os.environ.get("PADDLE_TRAINER_ID", "0")))
+        num = int(os.environ.get("JAX_PROCESS_COUNT",
+                                 os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS",
+                               os.environ.get("PADDLE_COORDINATOR", None))
+        return cls(idx, num, coord)
+
+
+_INITIALIZED = False
+
+
+def init(role: Optional[RoleMaker] = None) -> RoleMaker:
+    """Initialize multi-host JAX (Fleet.init parity).
+
+    Single-process (worker_num == 1) is a no-op; multi-process calls
+    ``jax.distributed.initialize`` — the coordination service replaces the
+    reference's out-of-band nccl-id/gRPC bootstrap. On TPU pods with
+    standard env, argument-less initialize() autodetects everything.
+    """
+    global _INITIALIZED
+    role = role or RoleMaker.from_env()
+    if role.worker_num > 1 and not _INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=role.coordinator,
+            num_processes=role.worker_num,
+            process_id=role.worker_index)
+        _INITIALIZED = True
+    return role
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def is_first_worker() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "fleet"):
+    """Cross-host sync point (fleet_util barrier parity)."""
+    from paddle_tpu.parallel import collective
+    from paddle_tpu.core.mesh import current_mesh, make_mesh
+
+    mesh = current_mesh() or make_mesh()
+    collective.barrier(axis=tuple(mesh.axis_names), mesh=mesh)
+
+
+def local_shard(batch, *, index: Optional[int] = None,
+                num: Optional[int] = None):
+    """Slice a host's shard out of a global host batch (the data-feed
+    filelist-split analog at batch granularity)."""
+    import numpy as np
+
+    index = jax.process_index() if index is None else index
+    num = jax.process_count() if num is None else num
+
+    def shard(x):
+        n = x.shape[0]
+        per = n // num
+        return x[index * per:(index + 1) * per]
+
+    return jax.tree_util.tree_map(shard, batch)

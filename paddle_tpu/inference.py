@@ -1,0 +1,86 @@
+"""Inference export/serving: the AnalysisPredictor-world replacement.
+
+Reference mapping (SURVEY.md §2.7):
+- ``save_inference_model`` (``io.py:974`` — prune program to feed/fetch
+  targets, serialize ProgramDesc ``__model__`` + params) →
+  :func:`save_inference_model`: serialize the jitted forward as portable
+  StableHLO (``jax.export``) + the param pytree. The StableHLO artifact is
+  the ``__model__`` analog: loadable without the Python model class.
+- ``AnalysisPredictor`` (api/analysis_predictor.h:47 — load, run analysis
+  passes, zero-copy run loop) → :class:`Predictor`. XLA replaces the
+  analysis pass pipeline (fuse passes ≙ XLA fusion; memory_optimize ≙
+  buffer assignment); a C++ PJRT runner is the planned native serving shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from paddle_tpu import io as io_lib
+
+_MODEL_FILE = "__model__.stablehlo"
+_PARAMS_FILE = "params.pkl"
+_META_FILE = "meta.json"
+
+
+def save_inference_model(path: str, fn, params: Any,
+                         example_inputs: Sequence[Any],
+                         input_names: Optional[Sequence[str]] = None):
+    """Export ``fn(params, *inputs)`` for serving.
+
+    Writes three artifacts into ``path`` (a directory):
+      __model__.stablehlo  portable serialized StableHLO (vm-agnostic)
+      params.pkl           host copy of the param pytree
+      meta.json            input names/shapes/dtypes (the feed contract)
+    """
+    os.makedirs(path, exist_ok=True)
+
+    def fwd(params, *inputs):
+        return fn(params, *inputs)
+
+    exp = jax_export.export(jax.jit(fwd))(params, *example_inputs)
+    with open(os.path.join(path, _MODEL_FILE), "wb") as f:
+        f.write(exp.serialize())
+    io_lib.save_params(params, os.path.join(path, _PARAMS_FILE))
+    names = list(input_names or
+                 [f"x{i}" for i in range(len(example_inputs))])
+    meta = {
+        "input_names": names,
+        "inputs": [{"shape": list(np.shape(a)),
+                    "dtype": str(np.asarray(a).dtype)}
+                   for a in example_inputs],
+    }
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_inference_model(path: str) -> "Predictor":
+    return Predictor(path)
+
+
+class Predictor:
+    """Zero-copy-ish serving wrapper over an exported model.
+
+    ``run(*inputs)`` or ``run(feed={name: array})`` — feed-dict parity with
+    the reference Executor feed/fetch protocol.
+    """
+
+    def __init__(self, path: str):
+        with open(os.path.join(path, _MODEL_FILE), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._params = io_lib.load_params(os.path.join(path, _PARAMS_FILE))
+        with open(os.path.join(path, _META_FILE)) as f:
+            self.meta = json.load(f)
+        self.input_names = self.meta["input_names"]
+
+    def run(self, *inputs, feed: Optional[Dict[str, Any]] = None):
+        if feed is not None:
+            inputs = tuple(feed[name] for name in self.input_names)
+        return self._exported.call(self._params, *inputs)

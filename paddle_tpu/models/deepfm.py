@@ -1,0 +1,66 @@
+"""DeepFM CTR model — BASELINE.json config[4] (high-dim sparse embeddings).
+
+Reference recipe: Paddle CTR models run on the async CPU/PS world — sparse
+``lookup_table`` pulled from pservers/pslib (``DownpourWorker``,
+``fleet_wrapper.h:76``), dense DNN towers trained hogwild. TPU-native: the
+embedding table is GSPMD-sharded on-chip (parallel/embedding.py), the whole
+model is one jitted step; FM + DNN towers are standard MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import nn as ops_nn
+from paddle_tpu.parallel.embedding import ShardedEmbedding
+
+
+class DeepFM(Layer):
+    """inputs: feat_ids (B, F) int feature ids hashed into [0, vocab);
+    optional feat_vals (B, F) float values (1.0 for categorical)."""
+
+    def __init__(self, vocab_size, num_fields, embed_dim=8,
+                 hidden=(400, 400, 400), axis="tp"):
+        super().__init__()
+        self.embedding = ShardedEmbedding(vocab_size, embed_dim, axis=axis)
+        self.linear_w = ShardedEmbedding(vocab_size, 1, axis=axis)
+        self.num_fields = num_fields
+        layers = []
+        in_dim = num_fields * embed_dim
+        for h in hidden:
+            layers.append(Linear(in_dim, h, sharding=None,
+                                 weight_init=I.xavier_uniform()))
+            in_dim = h
+        self.dnn = LayerList(layers)
+        self.dnn_out = Linear(in_dim, 1, sharding=None)
+        self.bias = self.create_parameter("bias", (1,), initializer=I.zeros)
+
+    def forward(self, params, feat_ids, feat_vals=None):
+        b, f = feat_ids.shape
+        if feat_vals is None:
+            feat_vals = jnp.ones((b, f), jnp.float32)
+        emb = self.embedding(params["embedding"], feat_ids)     # (B,F,D)
+        emb = emb * feat_vals[..., None]
+        # first order
+        w = self.linear_w(params["linear_w"], feat_ids)[..., 0]  # (B,F)
+        first = (w * feat_vals).sum(-1)
+        # FM second order: 0.5 * ((sum e)^2 - sum e^2)
+        s = emb.sum(axis=1)
+        second = 0.5 * (s * s - (emb * emb).sum(axis=1)).sum(-1)
+        # DNN tower
+        h = emb.reshape(b, -1)
+        for i, layer in enumerate(self.dnn):
+            h = jax.nn.relu(layer(params["dnn"][str(i)], h))
+        dnn_logit = self.dnn_out(params["dnn_out"], h)[:, 0]
+        return first + second + dnn_logit + params["bias"][0]
+
+    def loss(self, params, feat_ids, label, feat_vals=None):
+        """label: (B,) float 0/1 click. Returns (logloss, {auc-ready probs})."""
+        logits = self.forward(params, feat_ids, feat_vals)
+        loss = ops_nn.sigmoid_cross_entropy_with_logits(
+            logits, label.astype(jnp.float32)).mean()
+        return loss, {"prob_mean": jax.nn.sigmoid(logits).mean()}

@@ -1,0 +1,133 @@
+"""ResNet family (ResNet-18/34/50/101/152) — BASELINE.json config[1].
+
+Reference model: PaddleCV image_classification ResNet-50 (built on fluid
+``layers/nn.py`` conv2d:2417 + batch_norm:3871). TPU-native design: NHWC
+layout end-to-end (the TPU conv layout; the reference uses NCHW for cuDNN),
+BatchNorm running stats through the functional state tape, bf16-friendly
+(all convs feed the MXU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear, Pool2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import nn as ops_nn
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, groups=1,
+                 act=None):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias=False)
+        self.bn = BatchNorm(out_ch)
+        self.act = act
+
+    def forward(self, params, x, training=False):
+        x = self.conv(params["conv"], x)
+        x = self.bn(params["bn"], x, training=training)
+        if self.act == "relu":
+            x = jax.nn.relu(x)
+        return x
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=False):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride, act="relu")
+        self.conv2 = ConvBNLayer(ch, ch * 4, 1)
+        self.has_short = downsample
+        if downsample:
+            self.short = ConvBNLayer(in_ch, ch * 4, 1, stride=stride)
+
+    def forward(self, params, x, training=False):
+        y = self.conv0(params["conv0"], x, training=training)
+        y = self.conv1(params["conv1"], y, training=training)
+        y = self.conv2(params["conv2"], y, training=training)
+        s = self.short(params["short"], x, training=training) \
+            if self.has_short else x
+        return jax.nn.relu(y + s)
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=False):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 3, stride=stride, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3)
+        self.has_short = downsample
+        if downsample:
+            self.short = ConvBNLayer(in_ch, ch, 1, stride=stride)
+
+    def forward(self, params, x, training=False):
+        y = self.conv0(params["conv0"], x, training=training)
+        y = self.conv1(params["conv1"], y, training=training)
+        s = self.short(params["short"], x, training=training) \
+            if self.has_short else x
+        return jax.nn.relu(y + s)
+
+
+_DEPTHS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+}
+
+
+class ResNet(Layer):
+    """NHWC ResNet. ``width`` scales channel counts (width=64 standard;
+    tests use small widths)."""
+
+    def __init__(self, depth=50, num_classes=1000, width=64, in_ch=3):
+        super().__init__()
+        if depth not in _DEPTHS:
+            raise ValueError(f"depth must be one of {sorted(_DEPTHS)}")
+        block_cls, counts = _DEPTHS[depth]
+        self.stem = ConvBNLayer(in_ch, width, 7, stride=2, act="relu")
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        blocks = []
+        ch_in = width
+        for stage, n in enumerate(counts):
+            ch = width * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                downsample = (i == 0 and
+                              (stride != 1 or ch_in != ch * block_cls.expansion))
+                blocks.append(block_cls(ch_in, ch, stride=stride,
+                                        downsample=downsample))
+                ch_in = ch * block_cls.expansion
+        self.blocks = LayerList(blocks)
+        self.fc = Linear(ch_in, num_classes,
+                         weight_init=I.msra_uniform(fan_in=ch_in),
+                         sharding=None)
+
+    def forward(self, params, x, training=False):
+        """x: (B, H, W, C) NHWC images -> (B, num_classes) logits."""
+        x = self.stem(params["stem"], x, training=training)
+        x = self.pool(None, x)
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True):
+        logits = self.forward(params, image, training=training)
+        loss = ops_nn.softmax_with_cross_entropy(
+            logits, label[:, None]).mean()
+        acc = (logits.argmax(-1) == label).mean()
+        return loss, {"acc": acc}
+
+
+def ResNet50(num_classes=1000, **kw):
+    return ResNet(50, num_classes=num_classes, **kw)

@@ -82,7 +82,9 @@ class Layer:
         by construction order — reproducible given a seed (parity with fluid's
         per-program random seed).
         """
-        self._assign_paths(())
+        # keep the path assigned by the parent (non-empty when this init is
+        # a recursive call); only the true root starts at ()
+        self._assign_paths(self._path)
         params: Dict[str, Any] = {}
         names = list(self._param_specs) + list(self._sublayers)
         if names:

@@ -24,7 +24,8 @@ from paddle_tpu.ops import attention as ops_attn
 # Activation-sharding convention for transformer blocks:
 #   hidden activations (B, S, D): P(("dp","fsdp"), "sp", None)
 ACT_SPEC = P(("dp", "fsdp"), "sp", None)
-HEADS_SPEC = P(("dp", "fsdp"), "tp", None, None)  # (B, H, S, Dh)
+HEADS_SPEC = P(("dp", "fsdp"), "tp", None, None)       # (B, H, S, Dh)
+RING_HEADS_SPEC = P(("dp", "fsdp"), "tp", "sp", None)  # seq stays sharded
 
 
 def _constrain(x, spec):
@@ -53,6 +54,10 @@ class MultiHeadAttention(Layer):
         self.dropout_rate = dropout
         self.causal = causal
         self.attn_impl = attn_impl
+        if attn_impl == "ring" and dropout > 0.0:
+            raise ValueError(
+                "ring attention does not support attention-prob dropout; "
+                "set attn_dropout=0 (residual dropout still applies)")
         self.self_attention = self_attention
         if self_attention:
             self.qkv_proj = Linear(embed_dim, 3 * embed_dim, bias=bias,
@@ -87,13 +92,19 @@ class MultiHeadAttention(Layer):
                               query if key_value is None else key_value)
             k, v = jnp.split(kv, 2, axis=-1)
         q, k, v = (self._split_heads(t) for t in (q, k, v))
-        q = _constrain(q, HEADS_SPEC)
-        k = _constrain(k, HEADS_SPEC)
-        v = _constrain(v, HEADS_SPEC)
+        spec = RING_HEADS_SPEC if self.attn_impl == "ring" else HEADS_SPEC
+        q = _constrain(q, spec)
+        k = _constrain(k, spec)
+        v = _constrain(v, spec)
         drop_rate = self.dropout_rate if training else 0.0
-        out = ops_attn.dot_product_attention(
-            q, k, v, bias=bias, causal=self.causal,
-            dropout_rate=drop_rate, dropout_key=key, impl=self.attn_impl)
+        if self.attn_impl == "ring":
+            # sequence-parallel path: S sharded over "sp", k/v ride the ring
+            from paddle_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, bias=bias, causal=self.causal)
+        else:
+            out = ops_attn.dot_product_attention(
+                q, k, v, bias=bias, causal=self.causal,
+                dropout_rate=drop_rate, dropout_key=key, impl=self.attn_impl)
         out = self._merge_heads(out)
         out = self.out_proj(params["out_proj"], out)
         return _constrain(out, ACT_SPEC)
@@ -142,6 +153,9 @@ class TransformerEncoderLayer(Layer):
             x = x + self.drop(None, h, key=k2, training=training)
             h = self.ffn(params["ffn"], self.ln2(params["ln2"], x),
                          key=k3, training=training)
+            if key is not None:
+                h = self.drop(None, h, key=jax.random.fold_in(k3, 1),
+                              training=training)
             return x + h
         h = self.attn(params["attn"], x, bias=bias, key=k1, training=training)
         x = self.ln1(params["ln1"],

@@ -6,14 +6,22 @@ with mesh-and-sharding declarations compiled by XLA GSPMD.
 """
 
 from paddle_tpu.parallel import collective
-from paddle_tpu.parallel.api import (shard_eval_step, shard_train_step,
+from paddle_tpu.parallel.api import (batch_specs, shard_eval_step,
+                                     shard_train_step,
                                      with_sharding_constraint)
+from paddle_tpu.parallel.embedding import (ShardedEmbedding,
+                                           vocab_parallel_lookup)
 from paddle_tpu.parallel.plan import (Rule, ShardingPlan, fsdp_plan,
                                       megatron_plan, named_shardings,
                                       replicated_plan)
+from paddle_tpu.parallel.pipeline import (gpipe, microbatch,
+                                          stack_layer_params, unmicrobatch)
+from paddle_tpu.parallel.ring_attention import ring_attention
 
 __all__ = [
-    "collective", "shard_eval_step", "shard_train_step",
+    "collective", "batch_specs", "shard_eval_step", "shard_train_step",
     "with_sharding_constraint", "Rule", "ShardingPlan", "fsdp_plan",
     "megatron_plan", "named_shardings", "replicated_plan",
+    "ShardedEmbedding", "vocab_parallel_lookup", "ring_attention",
+    "gpipe", "microbatch", "stack_layer_params", "unmicrobatch",
 ]

@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe schedule over the "pp" mesh axis.
+
+Reference mapping: fluid's pipeline is a runtime construct — the program is
+cut into sections, each run by a ``SectionWorker`` thread with scope-queues
+between stages (``PipelineOptimizer`` optimizer.py:2931, ``PipelineTrainer``
+trainer.h:113, ``SectionWorker`` device_worker.h:267). TPU-native: the
+schedule is *traced* — a fori_loop over M + n - 1 ticks inside a shard_map
+over "pp"; activations hop stages via ``lax.ppermute`` (ICI neighbor
+transfer), and autodiff through the loop yields the reverse pipeline, so
+one jitted train step contains the whole fwd+bwd schedule.
+
+Constraint (same as scan-over-layers): pipelined blocks must be
+structurally identical — true for transformer stacks. Embedding/head run
+outside the pipelined middle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+
+
+def stack_layer_params(params_list):
+    """[{layer params}, ...] -> single pytree with stacked (L, ...) leaves
+    (the pipeline's weight layout; ≙ section programs per device)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params_list)
+
+
+def gpipe(
+    block_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x_microbatches,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = mesh_lib.PP,
+    remat: bool = True,
+):
+    """Run microbatches through a pipelined stack of identical blocks.
+
+    block_fn(layer_params, h) -> h; ``stacked_params`` leaves are
+    (L_total, ...) with L_total divisible by the "pp" axis size;
+    ``x_microbatches``: (M, mb, ...) microbatched activations.
+    Returns (M, mb, ...) outputs (replicated over "pp").
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("gpipe requires a mesh")
+    n = mesh.shape[axis]
+    M = x_microbatches.shape[0]
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def local_stage(local_params, h):
+        # apply this stage's L_total/n layers (scan over stacked leaves)
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+        h, _ = jax.lax.scan(body, h, local_params)
+        return h
+
+    def stage_body(local_params, x):
+        s = jax.lax.axis_index(axis)
+        is_first = s == 0
+        is_last = s == n - 1
+        T = M + n - 1
+        perm = [(i, i + 1) for i in range(n - 1)]
+        mb_shape = x.shape[1:]
+        received = jnp.zeros(mb_shape, x.dtype)
+        outputs = jnp.zeros_like(x)
+
+        def tick(t, carry):
+            received, outputs = carry
+            mb_idx = t - s
+            active = (mb_idx >= 0) & (mb_idx < M)
+            feed = jax.lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, M - 1), keepdims=False)
+            inp = jnp.where(is_first, feed, received)
+            h = local_stage(local_params, inp)
+            write_at = jnp.clip(mb_idx, 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, write_at,
+                                                keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(active & is_last, h, prev), write_at, 0)
+            received = jax.lax.ppermute(h, axis, perm)
+            return received, outputs
+
+        _, outputs = jax.lax.fori_loop(0, T, tick, (received, outputs))
+        # outputs are only valid on the last stage: replicate via psum
+        outputs = jnp.where(is_last, outputs, 0.0)
+        return jax.lax.psum(outputs, axis)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    return jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_microbatches)
+
+
+def microbatch(batch, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...) over every leaf."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((num_microbatches, -1) + x.shape[1:]), batch)
+
+
+def unmicrobatch(batch):
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), batch)

@@ -1,0 +1,135 @@
+"""Ring attention: sequence/context parallelism over the "sp" mesh axis.
+
+Capability ABSENT in the reference (SURVEY.md §5.7 — fluid 1.5 predates
+long-context training; its story was LoD ragged tensors + DynamicRNN). The
+TPU build adds it as a first-class axis: q/k/v are sharded on the sequence
+dim over "sp"; each device computes attention between its local queries and
+a rotating k/v block that travels the ring via ``lax.ppermute`` (ICI
+neighbor exchange), merging partial results with the flash-attention
+online-softmax recurrence. Memory per device is O(S/n · S/n) per block and
+the k/v transfer overlaps compute under XLA's async collectives.
+
+Composes with GSPMD: call :func:`ring_attention` under jit with a mesh
+context; the shard_map boundary converts the GSPMD-sharded (B,H,S,D)
+arrays to per-device local blocks and back.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.ops.attention import NEG_INF
+
+
+def _block_update(carry, kv, *, scale, causal, q_offset, k_offset, seq_q_blk):
+    """One online-softmax step: fold (k,v[,bias]) block into (m, l, acc).
+
+    q_offset/k_offset are the GLOBAL start positions of the local q block
+    and the visiting k block (traced ints ok) — used for causal masking.
+    """
+    m_prev, l_prev, acc = carry
+    q, k, v, bias = kv
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        blk_k = k.shape[2]
+        row = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (seq_q_blk, blk_k), 0)
+        col = k_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (seq_q_blk, blk_k), 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next)
+    l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    acc_next = acc * alpha + pv
+    return m_next, l_next, acc_next
+
+
+def _ring_attention_local(q, k, v, bias, *, axis, scale, causal):
+    """Per-device body (inside shard_map). q,k,v local: (B,H,Sl,D)."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, h, sl, d = q.shape
+    q32 = q.astype(jnp.float32)
+
+    m = jnp.full((b, h, sl, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sl, 1), jnp.float32)
+    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        m, l, acc, k, v, bias = carry
+        # block currently held arrived from (idx - i) mod n
+        src = jax.lax.rem(idx - i + n, n)
+        m, l, acc = _block_update(
+            (m, l, acc),
+            (q32, k.astype(jnp.float32), v, bias),
+            scale=scale, causal=causal,
+            q_offset=idx * sl, k_offset=src * sl, seq_q_blk=sl)
+        k = jax.lax.ppermute(k, axis, perm)
+        v = jax.lax.ppermute(v, axis, perm)
+        if bias is not None:
+            bias = jax.lax.ppermute(bias, axis, perm)
+        return m, l, acc, k, v, bias
+
+    if bias is None:
+        # keep the carry pytree static: loop without a bias leaf
+        def step_nb(i, carry):
+            m, l, acc, k, v = carry
+            m, l, acc, k2, v2, _ = step(i, (m, l, acc, k, v, None))
+            return m, l, acc, k2, v2
+        m, l, acc, _, _ = jax.lax.fori_loop(0, n, step_nb, (m, l, acc, k, v))
+    else:
+        m, l, acc, _, _, _ = jax.lax.fori_loop(0, n, step,
+                                               (m, l, acc, k, v, bias))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, bias=None, causal=False,
+                   scale: Optional[float] = None,
+                   axis: str = mesh_lib.SP, mesh: Optional[Mesh] = None):
+    """Sequence-parallel attention. q,k,v: (B,H,S,D) with S sharded over
+    ``axis``; ``bias`` optional key-padding bias (B,1,1,S) sharded on S.
+
+    Must run under a mesh (pjit/jit with mesh context). Returns (B,H,S,D)
+    with the same sharding as q.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention requires a mesh "
+                         "(use mesh_context or pass mesh=)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    qkv_spec = P(mesh_lib.BATCH_AXES, mesh_lib.TP, axis, None)
+    bias_spec = P(mesh_lib.BATCH_AXES, None, None, axis)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec)
+    args = (q, k, v)
+    if bias is not None:
+        in_specs = in_specs + (bias_spec,)
+        args = args + (bias,)
+
+        def body(q, k, v, bias):
+            return _ring_attention_local(q, k, v, bias, axis=axis,
+                                         scale=scale, causal=causal)
+    else:
+        def body(q, k, v):
+            return _ring_attention_local(q, k, v, None, axis=axis,
+                                         scale=scale, causal=causal)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
+        check_vma=False,
+    )(*args)

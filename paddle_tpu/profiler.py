@@ -1,0 +1,90 @@
+"""Profiling/tracing: host+device timeline with the reference's contract.
+
+Reference mapping (SURVEY.md §5.1): RAII ``RecordEvent`` wrapping every op
+(operator.cc:180) + CUPTI ``DeviceTracer`` correlating device activity +
+``tools/timeline.py`` Chrome-trace emission, driven by
+``fluid.profiler.profiler`` context managers (python/paddle/fluid/
+profiler.py). TPU-native: ``jax.profiler`` (XPlane → TensorBoard/Perfetto)
+carries the device side; ``record_event``/named_scope annotate traced
+regions so XLA ops correlate back to model code; a lightweight host-side
+event table reproduces the sorted per-op summary report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class _Events(threading.local):
+    def __init__(self):
+        self.active: Optional[List] = None
+
+
+_EVENTS = _Events()
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """Annotate a region: shows up in device traces (named_scope → XLA op
+    metadata) and, under :func:`profiler`, in the host event table."""
+    t0 = time.perf_counter()
+    with jax.named_scope(name):
+        yield
+    if _EVENTS.active is not None:
+        _EVENTS.active.append((name, time.perf_counter() - t0))
+
+
+@contextlib.contextmanager
+def profiler(output_dir: Optional[str] = None, *, summary: bool = True):
+    """Profile a region. With ``output_dir``, captures a jax.profiler trace
+    viewable in TensorBoard/XProf (device timeline ≙ CUPTI tracer + Chrome
+    trace). Always collects host record_event stats; prints the sorted
+    summary table on exit (EnableProfiler/DisableProfiler parity)."""
+    prev = _EVENTS.active
+    _EVENTS.active = []
+    if output_dir:
+        jax.profiler.start_trace(output_dir)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        wall = time.perf_counter() - t0
+        if output_dir:
+            jax.profiler.stop_trace()
+        events = _EVENTS.active
+        _EVENTS.active = prev
+        if summary and events:
+            print(format_summary(events, wall))
+
+
+def format_summary(events, wall: float) -> str:
+    """Sorted per-event table (profiler.cc sorted summaries)."""
+    agg: Dict[str, List[float]] = {}
+    for name, dt in events:
+        agg.setdefault(name, []).append(dt)
+    rows = sorted(agg.items(), key=lambda kv: -sum(kv[1]))
+    lines = [f"{'Event':<32}{'Calls':>8}{'Total(s)':>12}{'Avg(ms)':>12}"
+             f"{'Ratio':>8}"]
+    for name, ts in rows:
+        tot = sum(ts)
+        lines.append(f"{name:<32}{len(ts):>8}{tot:>12.4f}"
+                     f"{1e3 * tot / len(ts):>12.3f}"
+                     f"{tot / max(wall, 1e-9):>8.2%}")
+    return "\n".join(lines)
+
+
+def start_server(port: int = 9012):
+    """Live profiling endpoint (jax.profiler server) for on-demand capture."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def step_marker(step: int):
+    """Mark a training step (XProf StepEvents)."""
+    with jax.profiler.StepTraceAnnotation("train", step_num=step):
+        yield

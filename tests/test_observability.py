@@ -1,0 +1,108 @@
+"""Profiler, metrics, debug (NaN checks), fleet role tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import debug, fleet, metrics, profiler
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self, capsys):
+        with profiler.profiler(summary=True):
+            with profiler.record_event("fwd"):
+                jnp.ones((8, 8)) @ jnp.ones((8, 8))
+            with profiler.record_event("fwd"):
+                pass
+            with profiler.record_event("bwd"):
+                pass
+        out = capsys.readouterr().out
+        assert "fwd" in out and "bwd" in out
+        assert "Calls" in out
+        # fwd appears with 2 calls
+        fwd_line = next(l for l in out.splitlines() if l.startswith("fwd"))
+        assert "2" in fwd_line
+
+    def test_named_scope_traces(self):
+        # record_event must be usable inside jit (named_scope is traceable)
+        @jax.jit
+        def f(x):
+            with profiler.record_event("matmul"):
+                return x @ x
+
+        out = f(jnp.eye(4))
+        np.testing.assert_allclose(np.asarray(out), np.eye(4))
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = metrics.Accuracy()
+        m.update(np.array([[0.9, 0.1], [0.2, 0.8]]), np.array([0, 0]))
+        assert m.eval() == pytest.approx(0.5)
+        m.reset()
+        assert m.eval() == 0.0
+
+    def test_auc_perfect_and_random(self):
+        m = metrics.Auc()
+        probs = np.concatenate([np.random.RandomState(0).uniform(0.6, 1.0, 500),
+                                np.random.RandomState(1).uniform(0.0, 0.4, 500)])
+        labels = np.concatenate([np.ones(500), np.zeros(500)])
+        m.update(probs, labels)
+        assert m.eval() > 0.99
+        m2 = metrics.Auc()
+        rng = np.random.RandomState(2)
+        m2.update(rng.uniform(size=2000), rng.randint(0, 2, 2000))
+        assert 0.4 < m2.eval() < 0.6
+
+    def test_precision_recall(self):
+        m = metrics.PrecisionRecall()
+        m.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 0, 1, 0]))
+        r = m.eval()
+        assert r["precision"] == pytest.approx(0.5)
+        assert r["recall"] == pytest.approx(0.5)
+
+    def test_mean(self):
+        m = metrics.MeanMetric()
+        m.update(2.0).update(4.0)
+        assert m.eval() == pytest.approx(3.0)
+
+
+class TestDebug:
+    def test_check_numerics_passes_clean(self):
+        err, out = debug.checked(
+            lambda x: debug.check_numerics({"x": x}, "t"))(jnp.ones(3))
+        err.throw()  # no error
+
+    def test_check_numerics_catches_nan(self):
+        def f(x):
+            return debug.check_numerics({"x": x / x}, "t")
+
+        err, _ = debug.checked(f)(jnp.zeros(3))
+        with pytest.raises(Exception, match="non-finite"):
+            err.throw()
+
+    def test_finite_or_zero(self):
+        x = jnp.array([1.0, jnp.inf, jnp.nan])
+        np.testing.assert_allclose(np.asarray(debug.finite_or_zero(x)),
+                                   [1.0, 0.0, 0.0])
+
+
+class TestFleet:
+    def test_role_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        role = fleet.RoleMaker.from_env()
+        assert role.worker_index == 2
+        assert role.worker_num == 4
+        assert not role.is_first_worker()
+
+    def test_single_process_init_noop(self):
+        role = fleet.init(fleet.RoleMaker(0, 1))
+        assert role.is_first_worker()
+        assert fleet.worker_num() == 1
+
+    def test_local_shard(self):
+        batch = {"x": np.arange(8)}
+        out = fleet.local_shard(batch, index=1, num=4)
+        np.testing.assert_array_equal(out["x"], [2, 3])
