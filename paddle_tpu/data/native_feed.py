@@ -124,28 +124,50 @@ class DeviceLoader:
         self._iter = batch_iter
         self._sharding = sharding
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """put that aborts when the consumer closed us (early break would
+        otherwise park this thread on a full queue forever, pinning the
+        buffered device arrays)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self):
         import jax
         try:
             for batch in self._iter:
+                if self._stop.is_set():
+                    return
                 if self._sharding is not None:
                     batch = jax.device_put(batch, self._sharding)
                 else:
                     batch = jax.device_put(batch)
-                self._q.put(batch)
+                if not self._put(batch):
+                    return
         except Exception as e:  # surface in consumer
-            self._q.put(e)
+            self._put(e)
         finally:
-            self._q.put(None)
+            self._put(None)
+
+    def close(self):
+        self._stop.set()
 
     def __iter__(self):
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        try:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            self.close()

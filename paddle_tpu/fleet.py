@@ -101,6 +101,10 @@ def local_shard(batch, *, index: Optional[int] = None,
 
     def shard(x):
         n = x.shape[0]
+        if n % num:
+            raise ValueError(
+                f"batch dim {n} not divisible by {num} workers — pad or "
+                f"drop the remainder explicitly before sharding")
         per = n // num
         return x[index * per:(index + 1) * per]
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,8 @@ class TransformerConfig:
     num_decoder_layers: int = 6
     max_len: int = 256
     dropout: float = 0.1
+    attn_dropout: Optional[float] = None  # None = follow dropout; set 0
+                                          # to enable attn_impl="ring"
     label_smoothing: float = 0.1
     bos_id: int = 0
     eos_id: int = 1
@@ -76,14 +79,16 @@ class Transformer(Layer):
         self.drop = Dropout(cfg.dropout)
         self.encoder = LayerList([
             TransformerEncoderLayer(cfg.d_model, cfg.num_heads, cfg.ffn_size,
-                                    dropout=cfg.dropout, activation="relu",
-                                    pre_ln=cfg.pre_ln,
+                                    dropout=cfg.dropout,
+                                    attn_dropout=cfg.attn_dropout,
+                                    activation="relu", pre_ln=cfg.pre_ln,
                                     attn_impl=cfg.attn_impl)
             for _ in range(cfg.num_encoder_layers)])
         self.decoder = LayerList([
             TransformerDecoderLayer(cfg.d_model, cfg.num_heads, cfg.ffn_size,
-                                    dropout=cfg.dropout, activation="relu",
-                                    pre_ln=cfg.pre_ln,
+                                    dropout=cfg.dropout,
+                                    attn_dropout=cfg.attn_dropout,
+                                    activation="relu", pre_ln=cfg.pre_ln,
                                     attn_impl=cfg.attn_impl)
             for _ in range(cfg.num_decoder_layers)])
         # pre-LN stacks need a final LayerNorm
@@ -182,3 +187,61 @@ class Transformer(Layer):
 
         _, tgt, _ = jax.lax.while_loop(cond, body, (0, tgt, done))
         return tgt
+
+    def beam_search_decode(self, params, src_ids, *, beam_size: int = 4,
+                           max_len: Optional[int] = None,
+                           length_penalty: float = 0.6):
+        """Beam search (reference ``beam_search_op`` + ``layers.beam_search``
+        machine-translation path). GNMT-style length normalization
+        ((5+len)/6)^alpha. Returns (best_ids (B, T), best_scores (B,))."""
+        cfg = self.cfg
+        max_len = max_len or cfg.max_len
+        b = src_ids.shape[0]
+        k = beam_size
+        v = cfg.vocab_size
+        NEG = -1e9
+
+        memory, memory_bias = self.encode(params, src_ids)
+        # expand memory to beams: (B*K, S, D)
+        mem = jnp.repeat(memory, k, axis=0)
+        mem_bias = jnp.repeat(memory_bias, k, axis=0)
+
+        tgt = jnp.full((b, k, max_len), cfg.pad_id, jnp.int32)
+        tgt = tgt.at[:, :, 0].set(cfg.bos_id)
+        # beam 0 active, others start at -inf so step 1 fans out
+        scores = jnp.tile(jnp.array([0.0] + [NEG] * (k - 1)), (b, 1))
+        done = jnp.zeros((b, k), bool)
+
+        def penalty(length):
+            return ((5.0 + length) / 6.0) ** length_penalty
+
+        def body(t, carry):
+            tgt, scores, done = carry
+            logits = self.decode(params, tgt.reshape(b * k, max_len),
+                                 mem, mem_bias)[:, t]          # (B*K, V)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logp = logp.reshape(b, k, v)
+            # finished beams: only PAD continuation, score unchanged
+            pad_only = jnp.full((v,), NEG).at[cfg.pad_id].set(0.0)
+            logp = jnp.where(done[..., None], pad_only[None, None, :], logp)
+            cand = scores[..., None] + logp                    # (B, K, V)
+            flat = cand.reshape(b, k * v)
+            new_scores, idx = jax.lax.top_k(flat, k)           # (B, K)
+            src_beam = idx // v
+            tok = (idx % v).astype(jnp.int32)
+            tgt = jnp.take_along_axis(tgt, src_beam[..., None], axis=1)
+            tgt = tgt.at[:, :, t + 1].set(tok)
+            done = jnp.take_along_axis(done, src_beam, axis=1)
+            done = done | (tok == cfg.eos_id)
+            return tgt, new_scores, done
+
+        tgt, scores, done = jax.lax.fori_loop(
+            0, max_len - 1, body, (tgt, scores, done))
+        # length-normalized final ranking
+        lengths = (tgt != cfg.pad_id).sum(-1).astype(jnp.float32)
+        norm = scores / penalty(lengths)
+        best = jnp.argmax(norm, axis=1)
+        best_ids = jnp.take_along_axis(
+            tgt, best[:, None, None], axis=1)[:, 0]
+        best_scores = jnp.take_along_axis(norm, best[:, None], 1)[:, 0]
+        return best_ids, best_scores

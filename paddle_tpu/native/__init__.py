@@ -26,12 +26,16 @@ def build_library(name: str, sources, extra_flags=()) -> str:
     if os.path.exists(out) and all(
             os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
         return out
+    # compile to a temp name, then atomic-rename: a concurrent process must
+    # never dlopen a half-written .so
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *extra_flags, *srcs, "-o", out]
+           *extra_flags, *srcs, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {' '.join(cmd)}\n"
                            f"{proc.stderr}")
+    os.replace(tmp, out)
     return out
 
 

@@ -9,6 +9,9 @@ from paddle_tpu.nn.layers import (FC, BatchNorm, Conv2D, Dropout, Embedding,
 from paddle_tpu.nn.transformer import (FeedForward, MultiHeadAttention,
                                        TransformerDecoderLayer,
                                        TransformerEncoderLayer)
+from paddle_tpu.nn.moe import MoEFeedForward
+from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, RNN,
+                               SimpleRNNCell)
 
 __all__ = [
     "initializer", "Layer", "LayerList", "ParamSpec", "Sequential",
@@ -17,4 +20,6 @@ __all__ = [
     "Linear", "Pool2D",
     "FeedForward", "MultiHeadAttention", "TransformerDecoderLayer",
     "TransformerEncoderLayer",
+    "MoEFeedForward", "BiRNN", "GRUCell", "LSTM", "LSTMCell", "RNN",
+    "SimpleRNNCell",
 ]

@@ -171,13 +171,17 @@ class TransformerDecoderLayer(Layer):
     """Decoder block: causal self-attention + cross-attention + FFN."""
 
     def __init__(self, embed_dim, num_heads, ffn_dim, dropout=0.1,
-                 activation="relu", pre_ln=False, attn_impl="auto"):
+                 attn_dropout=None, activation="relu", pre_ln=False,
+                 attn_impl="auto"):
         super().__init__()
+        if attn_dropout is None:
+            attn_dropout = dropout
         self.self_attn = MultiHeadAttention(embed_dim, num_heads,
-                                            dropout=dropout, causal=True,
+                                            dropout=attn_dropout,
+                                            causal=True,
                                             attn_impl=attn_impl)
         self.cross_attn = MultiHeadAttention(embed_dim, num_heads,
-                                             dropout=dropout,
+                                             dropout=attn_dropout,
                                              self_attention=False,
                                              attn_impl=attn_impl)
         self.ffn = FeedForward(embed_dim, ffn_dim, activation, dropout)

@@ -1,0 +1,130 @@
+"""Sequence ops over padded+lengths batches (the LoD world, TPU-native).
+
+Reference mapping: ``operators/sequence_ops/`` (47 files — seq_pool,
+seq_expand, seq_pad/unpad, seq_mask, seq_softmax, seq_concat, seq_reverse
+over LoD ragged tensors, SURVEY.md §2.3). XLA needs static shapes, so the
+ragged representation is (data (B, T, ...), lengths (B,)) — sequence_pad
+parity is the representation itself; each op masks by lengths. Segment
+variants (segment_sum style) cover the packed-sequence layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("sequence_mask")
+def sequence_mask(lengths, maxlen=None, dtype=jnp.bool_):
+    """(B,) lengths -> (B, T) validity mask (sequence_mask_op)."""
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))  # requires concrete lengths
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Pool (B, T, D) over valid positions (sequence_pool_op:
+    sum/average/sqrt/max/last/first)."""
+    mask = sequence_mask(lengths, x.shape[1], x.dtype)[..., None]
+    if pool_type == "sum":
+        return (x * mask).sum(1)
+    if pool_type in ("average", "mean"):
+        denom = jnp.maximum(lengths[:, None], 1).astype(x.dtype)
+        return (x * mask).sum(1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(lengths[:, None], 1).astype(x.dtype))
+        return (x * mask).sum(1) / denom
+    if pool_type == "max":
+        neg = jnp.finfo(x.dtype).min
+        return jnp.where(mask > 0, x, neg).max(1)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None].repeat(
+            x.shape[-1], -1), axis=1)[:, 0]
+    if pool_type == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(x, lengths):
+    """Masked softmax over the time dim (sequence_softmax_op)."""
+    mask = sequence_mask(lengths, x.shape[1], jnp.bool_)
+    neg = jnp.asarray(-1e30, x.dtype)
+    z = jnp.where(mask, x, neg)
+    p = jax.nn.softmax(z, axis=1)
+    return jnp.where(mask, p, 0.0)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, lengths):
+    """Reverse each row's valid prefix, keeping padding in place
+    (sequence_reverse_op)."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(
+        x, src[..., None].repeat(x.shape[-1], -1) if x.ndim == 3 else src,
+        axis=1)
+
+
+@register_op("sequence_expand")
+def sequence_expand(x, times):
+    """Repeat each row i times[i] — static variant requires equal times
+    (LoD expand is data-dependent; use repeat for the general host-side
+    case). times: python int."""
+    return jnp.repeat(x, times, axis=0)
+
+
+@register_op("sequence_pad")
+def sequence_pad(rows, maxlen, pad_value=0.0):
+    """Host-side helper: list of (len_i, D) arrays -> (B, maxlen, D),
+    lengths. (sequence_pad_op — here padding happens at ingest, matching
+    the native feed's ragged slots.)"""
+    import numpy as np
+
+    b = len(rows)
+    d = np.shape(rows[0])[-1] if np.ndim(rows[0]) > 1 else None
+    shape = (b, maxlen, d) if d else (b, maxlen)
+    out = np.full(shape, pad_value, dtype=np.asarray(rows[0]).dtype)
+    lengths = np.zeros((b,), np.int64)
+    for i, r in enumerate(rows):
+        r = np.asarray(r)
+        n = min(len(r), maxlen)
+        out[i, :n] = r[:n]
+        lengths[i] = n
+    return jnp.asarray(out), jnp.asarray(lengths)
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(x, lengths):
+    """(B, T, ...) -> list of valid prefixes (host-side)."""
+    import numpy as np
+
+    xs = np.asarray(x)
+    ls = np.asarray(lengths)
+    return [xs[i, :ls[i]] for i in range(xs.shape[0])]
+
+
+# -- packed-segment variants (sequence packing for long-context training) --
+
+@register_op("segment_sum")
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@register_op("segment_max")
+def segment_max(data, segment_ids, num_segments):
+    return jax.ops.segment_max(data, segment_ids, num_segments)
+
+
+def make_segment_attention_bias(segment_ids, dtype=jnp.float32):
+    """Packed sequences: (B, T) segment ids -> additive bias blocking
+    cross-segment attention (the packed-batch story for Transformer-big
+    variable-length training; ≙ LoD isolation between sequences)."""
+    same = segment_ids[:, None, :] == segment_ids[:, :, None]  # (B,T,T)
+    return jnp.where(same, 0.0, -1e30).astype(dtype)[:, None, :, :]

@@ -30,6 +30,9 @@ from paddle_tpu.optimizer.regularizer import L1Decay, L2Decay
 tmap = jax.tree_util.tree_map
 
 
+from paddle_tpu.optimizer import compression  # noqa: E402  (DGC, LocalSGD)
+
+
 def _zeros_like_tree(params):
     return tmap(jnp.zeros_like, params)
 

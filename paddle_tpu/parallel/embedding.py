@@ -96,9 +96,16 @@ class ShardedEmbedding(Layer):
     def forward(self, params, ids):
         out = vocab_parallel_lookup(ids, params["weight"], axis=self.axis)
         if self.padding_idx is not None:
-            out = jnp.where((ids == self.padding_idx)[..., None], 0.0, out)
+            valid = ids != self.padding_idx
+            out = jnp.where(valid[..., None], out, 0.0)
         if self.combiner == "sum":
             out = out.sum(axis=-2)
         elif self.combiner == "mean":
-            out = out.mean(axis=-2)
+            if self.padding_idx is not None:
+                # mean over VALID ids only (sequence_pool "average" parity)
+                denom = jnp.maximum(
+                    valid.sum(axis=-1, keepdims=True), 1).astype(out.dtype)
+                out = out.sum(axis=-2) / denom
+            else:
+                out = out.mean(axis=-2)
         return out

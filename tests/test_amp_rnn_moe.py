@@ -1,0 +1,280 @@
+"""Tests: AMP loss scaling, RNN/LSTM/GRU, sequence ops, DGC, MoE, beam search."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import amp
+from paddle_tpu import optimizer as opt
+from paddle_tpu.nn.moe import MoEFeedForward
+from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, RNN,
+                               SimpleRNNCell)
+from paddle_tpu.ops import sequence as seq
+from paddle_tpu.optimizer.compression import DGC, LocalSGD
+
+
+class TestAMP:
+    def _setup(self):
+        from paddle_tpu.models.lenet import LeNet
+
+        model = LeNet(num_classes=4)
+        optimizer = opt.SGD(learning_rate=0.1)
+        state = amp.make_amp_state(model, optimizer, jax.random.PRNGKey(0))
+
+        def loss_fn(params, image, label):
+            logits = model(params, image)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.take_along_axis(logp, label[:, None], -1).mean()
+
+        step = jax.jit(amp.scaled_train_step(loss_fn, optimizer))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+        y = jnp.arange(4, dtype=jnp.int32)
+        return state, step, x, y
+
+    def test_scaled_step_learns_and_scale_tracked(self):
+        state, step, x, y = self._setup()
+        losses = []
+        for _ in range(6):
+            state, m = step(state, image=x, label=y)
+            assert bool(m["grads_finite"])
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert float(m["loss_scale"]) == 2.0 ** 15  # unchanged, no overflow
+        assert int(state["step"]) == 6
+
+    def test_overflow_skips_step_and_backs_off(self):
+        ls = amp.DynamicLossScale()
+        state = ls.init()
+        grads = {"w": jnp.array([jnp.inf, 1.0])}
+        assert not bool(ls.grads_finite(grads))
+        new = ls.update(state, jnp.asarray(False))
+        assert float(new["scale"]) == 2.0 ** 14  # backoff x0.5
+
+    def test_growth_after_interval(self):
+        ls = amp.DynamicLossScale(amp.LossScaleConfig(growth_interval=2))
+        state = ls.init()
+        for _ in range(2):
+            state = ls.update(state, jnp.asarray(True))
+        assert float(state["scale"]) == 2.0 ** 16
+
+
+class TestRNN:
+    def test_lstm_cell_shapes(self):
+        cell = LSTMCell(8, 16)
+        params = cell.init(jax.random.PRNGKey(0))
+        state = cell.initial_state(4)
+        (h, c), out = cell(params, state, jnp.ones((4, 8)))
+        assert h.shape == (4, 16) and c.shape == (4, 16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(h))
+
+    @pytest.mark.parametrize("cell_cls", [LSTMCell, GRUCell, SimpleRNNCell])
+    def test_rnn_unroll(self, cell_cls):
+        rnn = RNN(cell_cls(4, 8))
+        params = rnn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4))
+        outs, final = rnn(params, x)
+        assert outs.shape == (2, 5, 8)
+
+    def test_lengths_freeze_state(self):
+        """Ragged parity: state past a row's length must not change."""
+        rnn = RNN(LSTMCell(4, 8))
+        params = rnn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 4))
+        lengths = jnp.array([3, 6])
+        outs, (h, c) = rnn(params, x, lengths=lengths)
+        # outputs past length are zeroed
+        assert np.allclose(np.asarray(outs[0, 3:]), 0.0)
+        assert not np.allclose(np.asarray(outs[1, 3:]), 0.0)
+        # final state of row 0 equals state at t=3 (run truncated input)
+        outs3, (h3, _) = rnn(params, x[:, :3], lengths=jnp.array([3, 3]))
+        np.testing.assert_allclose(np.asarray(h[0]), np.asarray(h3[0]),
+                                   atol=1e-6)
+
+    def test_birnn_and_stacked_lstm(self):
+        bi = BiRNN(LSTMCell(4, 8), LSTMCell(4, 8))
+        params = bi.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4))
+        outs, _ = bi(params, x)
+        assert outs.shape == (2, 5, 16)
+
+        lstm = LSTM(4, 8, num_layers=2, bidirectional=True)
+        params = lstm.init(jax.random.PRNGKey(0))
+        outs, finals = lstm(params, x)
+        assert outs.shape == (2, 5, 16)
+        assert len(finals) == 2
+
+
+class TestSequenceOps:
+    def test_mask_and_pool(self):
+        lengths = jnp.array([2, 4])
+        x = jnp.ones((2, 4, 3))
+        m = seq.sequence_mask(lengths, 4)
+        np.testing.assert_array_equal(
+            np.asarray(m), [[1, 1, 0, 0], [1, 1, 1, 1]])
+        np.testing.assert_allclose(
+            np.asarray(seq.sequence_pool(x, lengths, "sum")[0]), 2.0)
+        np.testing.assert_allclose(
+            np.asarray(seq.sequence_pool(x, lengths, "mean")[1]), 1.0)
+
+    def test_pool_last_max(self):
+        x = jnp.arange(24.0).reshape(2, 4, 3)
+        lengths = jnp.array([2, 3])
+        last = seq.sequence_pool(x, lengths, "last")
+        np.testing.assert_allclose(np.asarray(last[0]), np.asarray(x[0, 1]))
+        mx = seq.sequence_pool(x, lengths, "max")
+        np.testing.assert_allclose(np.asarray(mx[1]), np.asarray(x[1, 2]))
+
+    def test_softmax_masked(self):
+        x = jnp.zeros((1, 4))
+        p = seq.sequence_softmax(x, jnp.array([2]))
+        np.testing.assert_allclose(np.asarray(p[0]), [0.5, 0.5, 0, 0],
+                                   atol=1e-6)
+
+    def test_reverse(self):
+        x = jnp.arange(8.0).reshape(1, 8)[..., None].repeat(2, -1)
+        r = seq.sequence_reverse(x, jnp.array([3]))
+        np.testing.assert_allclose(np.asarray(r[0, :3, 0]), [2, 1, 0])
+        np.testing.assert_allclose(np.asarray(r[0, 3:, 0]),
+                                   np.asarray(x[0, 3:, 0]))
+
+    def test_pad_unpad_roundtrip(self):
+        rows = [np.ones((2, 3)), np.ones((4, 3))]
+        padded, lengths = seq.sequence_pad(rows, 4)
+        assert padded.shape == (2, 4, 3)
+        back = seq.sequence_unpad(padded, lengths)
+        assert back[0].shape == (2, 3) and back[1].shape == (4, 3)
+
+    def test_segment_bias_blocks_cross_sequence(self):
+        seg = jnp.array([[0, 0, 1, 1]])
+        bias = seq.make_segment_attention_bias(seg)
+        assert bias.shape == (1, 1, 4, 4)
+        b = np.asarray(bias[0, 0])
+        assert b[0, 1] == 0.0 and b[0, 2] < -1e29
+
+
+class TestDGC:
+    def test_sparsifies_and_error_feedback(self):
+        dgc = DGC(momentum=0.0, sparsity=0.75)
+        params = {"w": jnp.zeros(8)}
+        state = dgc.init(params)
+        g = {"w": jnp.array([1., 2., 3., 4., 5., 6., 7., 8.])}
+        out, state = dgc.transform(g, state)
+        nz = int((np.asarray(out["w"]) != 0).sum())
+        assert nz == 2  # top 25% of 8
+        # dropped grads persist in residual and flush later
+        resid = np.asarray(state["v"]["w"])
+        assert resid[0] == 1.0 and resid[-1] == 0.0
+        out2, state = dgc.transform({"w": jnp.zeros(8)}, state)
+        total = np.asarray(out["w"]) + np.asarray(out2["w"]) \
+            + np.asarray(state["v"]["w"])
+        np.testing.assert_allclose(total, np.asarray(g["w"]))  # no loss
+
+    def test_localsgd_averages_on_schedule(self, mesh8):
+        from paddle_tpu.core.mesh import mesh_context
+        from jax.sharding import PartitionSpec as P
+
+        ls = LocalSGD(k_steps=2, axis="dp")
+
+        def body(p, step):
+            return ls.maybe_average({"w": p}, step)["w"]
+
+        with mesh_context(mesh8):
+            f = jax.shard_map(body, mesh=mesh8,
+                              in_specs=(P("dp"), P()), out_specs=P("dp"),
+                              check_vma=False)
+            p = jnp.arange(8.0)
+            avg = f(p, jnp.asarray(2))   # step % 2 == 0 -> average
+            noavg = f(p, jnp.asarray(3))
+        np.testing.assert_allclose(np.asarray(avg), 3.5)
+        np.testing.assert_allclose(np.asarray(noavg), np.arange(8.0))
+
+
+class TestMoE:
+    def test_forward_and_balance(self):
+        moe = MoEFeedForward(16, 32, num_experts=4, top_k=1,
+                             capacity_factor=2.0)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        y, aux = moe(params, x)
+        assert y.shape == x.shape
+        assert float(aux["aux_loss"]) > 0
+        assert int(np.asarray(aux["expert_counts"]).sum()) == 16
+
+    def test_ep_sharded(self):
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
+
+        mesh = make_mesh(MeshConfig(dp=2, ep=4))
+        moe = MoEFeedForward(16, 32, num_experts=4, top_k=2,
+                             capacity_factor=2.0)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+        ref, _ = moe(params, x)
+        from paddle_tpu.parallel import plan as plan_lib
+        hints = moe.sharding_specs(params)
+        specs = plan_lib.ShardingPlan().params_specs(params, hints)
+        sh = plan_lib.named_shardings(mesh, specs)
+        placed = jax.device_put(params, sh)
+        with mesh_context(mesh):
+            out, _ = jax.jit(lambda p, x: moe(p, x))(placed, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestBeamSearch:
+    def test_beam_beats_or_matches_greedy(self):
+        from paddle_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+
+        cfg = TransformerConfig.tiny(attn_impl="xla", dropout=0.0)
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        src = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 3,
+                                 cfg.vocab_size, jnp.int32)
+        ids, scores = jax.jit(
+            lambda p, s: model.beam_search_decode(p, s, beam_size=3,
+                                                  max_len=12))(params, src)
+        assert ids.shape == (2, 12)
+        assert scores.shape == (2,)
+        assert (np.asarray(ids[:, 0]) == cfg.bos_id).all()
+        assert np.isfinite(np.asarray(scores)).all()
+
+
+class TestReviewRegressions:
+    def test_amp_step_updates_bn_stats(self):
+        """scaled_train_step must run the state tape (BN running stats)."""
+        from paddle_tpu.models.resnet import ResNet
+
+        model = ResNet(18, num_classes=4, width=8)
+        optimizer = opt.SGD(learning_rate=0.01)
+        state = amp.make_amp_state(model, optimizer, jax.random.PRNGKey(0))
+
+        def loss_fn(params, image, label):
+            return model.loss(params, image, label, training=True)
+
+        step = jax.jit(amp.scaled_train_step(loss_fn, optimizer))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3)) + 2.0
+        y = jnp.zeros((4,), jnp.int32)
+        state, m = step(state, image=x, label=y)
+        assert bool(m["grads_finite"])
+        stem_mean = np.asarray(state["params"]["stem"]["bn"]["mean"])
+        assert not np.allclose(stem_mean, 0.0)
+
+    def test_sharded_embedding_mean_ignores_padding(self):
+        from paddle_tpu.parallel.embedding import ShardedEmbedding
+
+        layer = ShardedEmbedding(16, 4, combiner="mean", padding_idx=0)
+        params = layer.init(jax.random.PRNGKey(0))
+        out = layer(params, jnp.array([[3, 0, 0]]))
+        ref = params["weight"][3]  # mean over 1 valid id, not /3
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_device_loader_early_break_no_hang(self):
+        from paddle_tpu.data.native_feed import DeviceLoader
+
+        loader = DeviceLoader(iter([{"x": np.ones(2)}] * 10), buffer_size=1)
+        for batch in loader:
+            break  # worker must unblock and exit
+        loader._thread.join(timeout=5)
+        assert not loader._thread.is_alive()
