@@ -10,7 +10,8 @@ from paddle_tpu.version import __version__
 
 from paddle_tpu import (amp, config, core, data, debug, fleet, inference,
                         io, metrics, models, nn, ops, optimizer, parallel,
-                        profiler, train)
+                        profiler, train, trainer)
+from paddle_tpu.trainer import Trainer
 from paddle_tpu.config import global_config, set_flags
 from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
 from paddle_tpu.executor import CompiledProgram, Executor, Program
@@ -19,7 +20,7 @@ from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
 __all__ = [
     "__version__", "amp", "config", "core", "data", "debug", "fleet",
     "inference", "io", "metrics", "models", "nn", "ops", "optimizer",
-    "parallel", "profiler", "train",
+    "parallel", "profiler", "train", "trainer", "Trainer",
     "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
     "CompiledProgram", "Executor", "Program",
     "build_eval_step", "build_train_step", "make_train_state",

@@ -90,6 +90,47 @@ def barrier(name: str = "fleet"):
     collective.barrier(axis=tuple(mesh.axis_names), mesh=mesh)
 
 
+class HeartbeatMonitor:
+    """Training-stall watchdog (operators/distributed/heart_beat_monitor.h:54
+    ``LostWorkerMonitor`` parity — there: pserver tracks per-worker update
+    times; here: a host thread tracks step progress and calls ``on_stall``
+    when no beat arrives within the timeout)."""
+
+    def __init__(self, timeout_s: float = 300.0, *, check_every_s: float = 10.0,
+                 on_stall=None, log_fn=print):
+        import threading
+        import time as _time
+
+        self.timeout_s = timeout_s
+        self._last = _time.monotonic()
+        self._step = -1
+        self._stop = threading.Event()
+        self._on_stall = on_stall
+        self._log = log_fn
+
+        def watch():
+            while not self._stop.wait(check_every_s):
+                idle = _time.monotonic() - self._last
+                if idle > self.timeout_s:
+                    msg = (f"[heartbeat] no progress for {idle:.0f}s "
+                           f"(last step {self._step})")
+                    self._log(msg)
+                    if self._on_stall is not None:
+                        self._on_stall(self._step, idle)
+
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+
+    def beat(self, step: int):
+        import time as _time
+
+        self._last = _time.monotonic()
+        self._step = step
+
+    def stop(self):
+        self._stop.set()
+
+
 def local_shard(batch, *, index: Optional[int] = None,
                 num: Optional[int] = None):
     """Slice a host's shard out of a global host batch (the data-feed

@@ -94,6 +94,62 @@ class MeanMetric(Metric):
         return self._sum / max(self._n, 1e-12)
 
 
+class ChunkEvaluator(Metric):
+    """Chunking F1 for sequence labeling (fluid metrics.ChunkEvaluator +
+    ``chunk_eval`` op). Tags follow IOB: tag = chunk_type * 2 + {0:B, 1:I},
+    with ``num_chunk_types * 2`` == outside tag ("O")."""
+
+    def __init__(self, num_chunk_types: int):
+        self.num_chunk_types = num_chunk_types
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0.0
+        self.num_label = 0.0
+        self.num_correct = 0.0
+
+    @staticmethod
+    def extract_chunks(tags, num_chunk_types):
+        """[(start, end, type), ...] from an IOB tag sequence."""
+        chunks = []
+        start = ctype = None
+        tags = list(np.asarray(tags))
+        for i, t in enumerate(tags + [num_chunk_types * 2]):
+            is_begin = t < num_chunk_types * 2 and t % 2 == 0
+            is_inside = t < num_chunk_types * 2 and t % 2 == 1
+            cur_type = t // 2 if t < num_chunk_types * 2 else None
+            if start is not None and (not is_inside or cur_type != ctype):
+                chunks.append((start, i, ctype))
+                start = ctype = None
+            if is_begin:
+                start, ctype = i, cur_type
+        return chunks
+
+    def update(self, infer_tags, label_tags, lengths=None):
+        infer_tags = np.asarray(infer_tags)
+        label_tags = np.asarray(label_tags)
+        if infer_tags.ndim == 1:
+            infer_tags = infer_tags[None]
+            label_tags = label_tags[None]
+        for i in range(infer_tags.shape[0]):
+            n = int(lengths[i]) if lengths is not None \
+                else infer_tags.shape[1]
+            inf = set(self.extract_chunks(infer_tags[i, :n],
+                                          self.num_chunk_types))
+            lab = set(self.extract_chunks(label_tags[i, :n],
+                                          self.num_chunk_types))
+            self.num_infer += len(inf)
+            self.num_label += len(lab)
+            self.num_correct += len(inf & lab)
+        return self
+
+    def eval(self):
+        p = self.num_correct / max(self.num_infer, 1e-12)
+        r = self.num_correct / max(self.num_label, 1e-12)
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"precision": p, "recall": r, "f1": f1}
+
+
 class PrecisionRecall(Metric):
     """Binary precision/recall/F1 at a threshold (metrics.Precision/Recall)."""
 

@@ -7,7 +7,11 @@ from paddle_tpu.models.bert import (BertConfig, BertModel, BertForPretraining)
 from paddle_tpu.models.resnet import ResNet, ResNet50
 from paddle_tpu.models.deepfm import DeepFM
 from paddle_tpu.models.transformer import Transformer, TransformerConfig
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.book import (LinearRegression, RNNLanguageModel,
+                                    SentimentLSTM, SkipGramNS, Word2Vec)
 
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
-           "TransformerConfig"]
+           "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
+           "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec"]

@@ -1,0 +1,21 @@
+"""Public-API surface freeze (API.spec / check_api_approvals parity):
+changing the surface requires regenerating api_spec.txt in the same commit."""
+
+import os
+import subprocess
+import sys
+
+
+def test_api_spec_up_to_date():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_path = os.path.join(root, "api_spec.txt")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_api_spec.py")],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    current = proc.stdout
+    with open(spec_path) as f:
+        frozen = f.read()
+    assert current == frozen, (
+        "public API changed — review the diff and regenerate: "
+        "python tools/gen_api_spec.py > api_spec.txt")

@@ -1,0 +1,123 @@
+"""Tests: control-flow ops, TensorArray, quantization ops, ChunkEvaluator,
+HeartbeatMonitor, API-spec tooling."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import fleet, metrics
+from paddle_tpu.ops import control_flow as cf
+from paddle_tpu.ops import quant
+
+
+class TestControlFlow:
+    def test_while_and_cond(self):
+        out = cf.while_loop(lambda x: x < 10, lambda x: x * 2, jnp.asarray(1))
+        assert int(out) == 16
+        y = cf.cond(jnp.asarray(True), lambda a: a + 1, lambda a: a - 1,
+                    jnp.asarray(5))
+        assert int(y) == 6
+
+    def test_case(self):
+        f = jax.jit(lambda i, x: cf.case(i, [lambda a: a, lambda a: a * 10,
+                                             lambda a: a * 100], x))
+        assert int(f(jnp.asarray(2), jnp.asarray(3))) == 300
+
+    def test_scan_cumsum(self):
+        def body(c, x):
+            c = c + x
+            return c, c
+        _, ys = cf.scan(body, jnp.asarray(0.0), jnp.arange(4.0))
+        np.testing.assert_allclose(np.asarray(ys), [0, 1, 3, 6])
+
+    def test_tensor_array_in_jit(self):
+        def f(xs):
+            ta = cf.TensorArray(4, (2,))
+
+            def body(i, ta):
+                return ta.write(i, xs[i] * 2)
+
+            ta = cf.fori_loop(0, 4, body, ta)
+            return ta.stack(), ta.read(2)
+
+        xs = jnp.arange(8.0).reshape(4, 2)
+        stacked, third = jax.jit(f)(xs)
+        np.testing.assert_allclose(np.asarray(stacked), np.asarray(xs) * 2)
+        np.testing.assert_allclose(np.asarray(third), [8.0, 10.0])
+
+
+class TestQuant:
+    def test_fake_quant_abs_max_roundtrip(self):
+        x = jnp.asarray([-1.0, -0.5, 0.0, 0.5, 1.0])
+        fq, scale = quant.fake_quantize_abs_max(x, bit_length=8)
+        assert float(scale) == pytest.approx(1.0)
+        np.testing.assert_allclose(np.asarray(fq), np.asarray(x), atol=1e-2)
+
+    def test_quant_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64,))
+        fq, scale = quant.fake_quantize_abs_max(x, bit_length=8)
+        max_err = float(jnp.abs(fq - x).max())
+        assert max_err <= float(scale) / 127 + 1e-6
+
+    def test_ste_gradient_passes_through(self):
+        g = jax.grad(lambda x: quant.fake_quantize_abs_max(x)[0].sum())(
+            jnp.asarray([0.3, -0.7]))
+        np.testing.assert_allclose(np.asarray(g), 1.0, atol=1e-5)
+
+    def test_channel_wise(self):
+        x = jnp.stack([jnp.ones(4) * 0.1, jnp.ones(4) * 10.0], axis=1)
+        fq, scales = quant.fake_channel_wise_quantize_abs_max(x, axis=1)
+        assert scales.shape == (2,)
+        np.testing.assert_allclose(np.asarray(fq), np.asarray(x), rtol=1e-2)
+
+    def test_moving_average_observer(self):
+        x = jnp.ones(8) * 2.0
+        _, s1 = quant.fake_quantize_moving_average_abs_max(
+            x, jnp.asarray(1.0), momentum=0.5)
+        assert float(s1) == pytest.approx(1.5)
+        _, s_eval = quant.fake_quantize_moving_average_abs_max(
+            x, jnp.asarray(1.0), training=False)
+        assert float(s_eval) == 1.0
+
+    def test_quantize_weight_tree(self):
+        params = {"fc": {"weight": jnp.eye(4) * 3.0, "bias": jnp.ones(4)}}
+        q = quant.quantize_weight_tree(params)
+        np.testing.assert_allclose(np.asarray(q["fc"]["bias"]), 1.0)
+        np.testing.assert_allclose(np.asarray(q["fc"]["weight"]),
+                                   np.eye(4) * 3.0, atol=0.05)
+
+
+class TestChunkEvaluator:
+    def test_extract_chunks_iob(self):
+        # types: 0 -> tags B=0,I=1; 1 -> B=2,I=3; O=4
+        tags = [0, 1, 4, 2, 3, 3, 0]
+        chunks = metrics.ChunkEvaluator.extract_chunks(tags, 2)
+        assert chunks == [(0, 2, 0), (3, 6, 1), (6, 7, 0)]
+
+    def test_f1(self):
+        ev = metrics.ChunkEvaluator(num_chunk_types=2)
+        label = [0, 1, 4, 2, 3]
+        infer = [0, 1, 4, 4, 4]   # finds 1 of 2 chunks, no false positives
+        ev.update(infer, label)
+        r = ev.eval()
+        assert r["precision"] == pytest.approx(1.0)
+        assert r["recall"] == pytest.approx(0.5)
+
+
+class TestHeartbeat:
+    def test_stall_detected_and_beat_resets(self):
+        stalls = []
+        mon = fleet.HeartbeatMonitor(timeout_s=0.2, check_every_s=0.05,
+                                     on_stall=lambda s, t: stalls.append(s),
+                                     log_fn=lambda m: None)
+        mon.beat(1)
+        time.sleep(0.5)
+        assert stalls  # stall fired
+        mon.beat(2)
+        n = len(stalls)
+        time.sleep(0.1)
+        assert len(stalls) == n  # beat reset the timer
+        mon.stop()

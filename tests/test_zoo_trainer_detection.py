@@ -1,0 +1,216 @@
+"""Tests: book model zoo, GPT, Trainer driver, detection ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models.book import (LinearRegression, RNNLanguageModel,
+                                    SentimentLSTM, SkipGramNS, Word2Vec)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.ops import detection as det
+from paddle_tpu.train import build_train_step, make_train_state
+from paddle_tpu.trainer import Trainer
+
+
+def _fit(model, loss_kwargs_fn, steps=25, lr=1e-2, optimizer=None):
+    optimizer = optimizer or opt.Adam(learning_rate=lr)
+    state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+    def loss_fn(params, **kw):
+        return model.loss(params, **kw)
+
+    step = jax.jit(build_train_step(loss_fn, optimizer))
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, **loss_kwargs_fn())
+        losses.append(float(m["loss"]))
+    return losses, state, m
+
+
+class TestBookModels:
+    def test_fit_a_line(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(32, 13)).astype(np.float32))
+        w_true = jnp.asarray(rng.normal(size=13).astype(np.float32))
+        y = x @ w_true + 0.5
+        losses, _, _ = _fit(LinearRegression(13),
+                            lambda: dict(x=x, y=y), steps=200, lr=0.1)
+        assert losses[-1] < 0.05
+
+    def test_word2vec_ngram(self):
+        model = Word2Vec(vocab_size=50, embed_dim=8, context=4, hidden=16)
+        ctx = jax.random.randint(jax.random.PRNGKey(0), (16, 4), 0, 50)
+        tgt = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 50)
+        losses, _, _ = _fit(model, lambda: dict(context_ids=ctx,
+                                                target_ids=tgt), steps=40)
+        assert losses[-1] < losses[0]
+
+    def test_skipgram_ns(self):
+        model = SkipGramNS(vocab_size=50, embed_dim=8)
+        c = jax.random.randint(jax.random.PRNGKey(0), (32,), 0, 50)
+        p = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 50)
+        n = jax.random.randint(jax.random.PRNGKey(2), (32, 5), 0, 50)
+        losses, _, _ = _fit(model, lambda: dict(center=c, positive=p,
+                                                negatives=n), steps=30)
+        assert losses[-1] < losses[0]
+
+    def test_sentiment_lstm(self):
+        model = SentimentLSTM(vocab_size=40, num_classes=2, embed_dim=8,
+                              hidden=16, num_layers=1)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (8, 12), 1, 40)
+        lengths = jnp.full((8,), 12)
+        label = (ids[:, 0] % 2).astype(jnp.int32)  # learnable signal
+        losses, _, m = _fit(model, lambda: dict(ids=ids, lengths=lengths,
+                                                label=label), steps=50)
+        assert losses[-1] < losses[0]
+        assert float(m["acc"]) > 0.7
+
+    def test_rnn_lm_ppl(self):
+        model = RNNLanguageModel(vocab_size=30, embed_dim=16, hidden=16)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (4, 10), 0, 30)
+        tgt = jnp.roll(ids, -1, axis=1)
+        losses, _, m = _fit(model, lambda: dict(ids=ids, targets=tgt),
+                            steps=40)
+        assert losses[-1] < losses[0]
+        assert float(m["ppl"]) == pytest.approx(np.exp(losses[-1]), rel=1e-3)
+
+
+class TestGPT:
+    def test_lm_learns_and_generates(self):
+        cfg = GPTConfig.tiny(attn_impl="xla")
+        model = GPT(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0,
+                                 cfg.vocab_size)
+        losses, state, _ = _fit(model, lambda: dict(ids=ids), steps=40,
+                                lr=3e-3)
+        assert losses[-1] < losses[0]
+        out = jax.jit(lambda p, x: model.generate(p, x, max_new_tokens=8))(
+            state["params"], ids[:2, :4])
+        assert out.shape == (2, 12)
+
+    def test_causality(self):
+        cfg = GPTConfig.tiny(attn_impl="xla")
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0,
+                                 cfg.vocab_size)
+        ids2 = ids.at[0, 10].set((ids[0, 10] + 1) % cfg.vocab_size)
+        l1 = model(params, ids)
+        l2 = model(params, ids2)
+        np.testing.assert_allclose(np.asarray(l1[0, :10]),
+                                   np.asarray(l2[0, :10]), atol=1e-5)
+
+
+class TestTrainer:
+    def _pieces(self, tmp_path=None):
+        model = LinearRegression(4)
+        optimizer = opt.SGD(learning_rate=0.1)
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        step = jax.jit(build_train_step(
+            lambda p, **kw: model.loss(p, **kw), optimizer))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+        y = jnp.asarray(x[:, 0] * 2 - 1)
+        batches = [dict(x=x, y=y)] * 10
+        return step, state, batches
+
+    def test_fit_runs_and_logs(self):
+        step, state, batches = self._pieces()
+        logs = []
+        tr = Trainer(step, state, log_every=5, log_fn=logs.append)
+        metrics = tr.fit(batches, epochs=2,
+                         make_iter=lambda: iter(list(batches)))
+        assert tr.step_count == 20
+        assert metrics["loss"] < 1.0
+        assert any("step" in l for l in logs)
+
+    def test_checkpoint_resume(self, tmp_path):
+        step, state, batches = self._pieces()
+        tr = Trainer(step, state, checkpoint_dir=str(tmp_path / "c"),
+                     checkpoint_every=5, log_every=0, log_fn=lambda s: None)
+        tr.fit(batches, epochs=1)
+        assert tr.manager.latest_step() == 10
+
+        # crash + restart: a fresh trainer resumes where the first stopped
+        step2, state2, _ = self._pieces()
+        tr2 = Trainer(step2, state2, checkpoint_dir=str(tmp_path / "c"),
+                      log_every=0, log_fn=lambda s: None)
+        resumed = tr2.restore()
+        assert resumed == 10
+        tr2.fit(batches, epochs=1)
+        assert tr2.step_count == 20
+
+    def test_hooks_called(self):
+        step, state, batches = self._pieces()
+        calls = []
+        tr = Trainer(step, state, log_every=0,
+                     hooks=[lambda t, n, m: calls.append(n)])
+        tr.fit(batches, epochs=1)
+        assert calls == list(range(1, 11))
+
+
+class TestDetectionOps:
+    def test_box_iou(self):
+        b1 = jnp.array([[0, 0, 2, 2]], jnp.float32)
+        b2 = jnp.array([[1, 1, 3, 3], [0, 0, 2, 2]], jnp.float32)
+        iou = det.box_iou(b1, b2)
+        np.testing.assert_allclose(np.asarray(iou[0]), [1 / 7, 1.0],
+                                   atol=1e-6)
+
+    def test_box_code_roundtrip(self):
+        anchors = jnp.array([[0, 0, 10, 10], [5, 5, 20, 25]], jnp.float32)
+        boxes = jnp.array([[1, 2, 11, 13], [4, 6, 22, 24]], jnp.float32)
+        deltas = det.box_encode(boxes, anchors)
+        back = det.box_decode(deltas, anchors)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(boxes),
+                                   atol=1e-4)
+
+    def test_prior_box(self):
+        boxes = det.prior_box(2, 2, 32, 32, min_sizes=(8,), max_sizes=(16,),
+                              aspect_ratios=(1.0, 2.0))
+        # A = 1 (min) + 2 (ar=2 two orientations? no: ar2 adds 1) + 1 (max)
+        assert boxes.shape[1] == 4
+        assert float(boxes.min()) >= 0.0 and float(boxes.max()) <= 1.0
+
+    def test_nms_suppresses(self):
+        boxes = jnp.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                          jnp.float32)
+        scores = jnp.array([0.9, 0.8, 0.7])
+        idxs, valid = det.nms(boxes, scores, iou_threshold=0.5,
+                              max_outputs=3)
+        kept = np.asarray(idxs)[np.asarray(valid)]
+        assert list(kept) == [0, 2]  # box 1 suppressed by box 0
+
+    def test_nms_score_threshold(self):
+        boxes = jnp.array([[0, 0, 1, 1], [5, 5, 6, 6]], jnp.float32)
+        scores = jnp.array([0.9, 0.01])
+        _, valid = det.nms(boxes, scores, score_threshold=0.5,
+                           max_outputs=2)
+        assert int(np.asarray(valid).sum()) == 1
+
+    def test_multiclass_nms(self):
+        boxes = jnp.array([[0, 0, 10, 10], [20, 20, 30, 30]], jnp.float32)
+        scores = jnp.array([[0.9, 0.1], [0.2, 0.8]])
+        cls_ids, idxs, valid = det.multiclass_nms(
+            boxes, scores, score_threshold=0.5, max_per_class=2)
+        kept = [(int(c), int(i)) for c, i, v in
+                zip(cls_ids, idxs, np.asarray(valid)) if v]
+        assert (0, 0) in kept and (1, 1) in kept
+
+    def test_yolo_box_shapes(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3 * 7, 4, 4))
+        img_size = jnp.array([[416, 416], [320, 640]], jnp.int32)
+        boxes, scores = det.yolo_box(x, img_size,
+                                     anchors=[(10, 13), (16, 30), (33, 23)],
+                                     class_num=2)
+        assert boxes.shape == (2, 48, 4)
+        assert scores.shape == (2, 48, 2)
+
+    def test_roi_align_constant_field(self):
+        feat = jnp.ones((16, 16, 3))
+        rois = jnp.array([[2, 2, 10, 10]], jnp.float32)
+        out = det.roi_align(feat, rois, output_size=(4, 4))
+        assert out.shape == (1, 4, 4, 3)
+        np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)

@@ -1,0 +1,63 @@
+"""Generate the frozen public-API spec (reference: ``paddle/fluid/API.spec``
++ ``tools/check_api_approvals.sh`` — surface changes must be explicit).
+
+Usage:  python tools/gen_api_spec.py > api_spec.txt
+Test:   tests/test_api_spec.py regenerates and diffs against api_spec.txt.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# never touch the (possibly busy) TPU for a pure-introspection task
+jax.config.update("jax_platforms", "cpu")
+
+
+def iter_api():
+    import paddle_tpu as pt
+
+    modules = {
+        "paddle_tpu": pt,
+        "paddle_tpu.nn": pt.nn,
+        "paddle_tpu.ops": pt.ops,
+        "paddle_tpu.optimizer": pt.optimizer,
+        "paddle_tpu.models": pt.models,
+        "paddle_tpu.parallel": pt.parallel,
+        "paddle_tpu.io": pt.io,
+        "paddle_tpu.amp": pt.amp,
+        "paddle_tpu.metrics": pt.metrics,
+        "paddle_tpu.inference": pt.inference,
+        "paddle_tpu.fleet": pt.fleet,
+        "paddle_tpu.profiler": pt.profiler,
+        "paddle_tpu.debug": pt.debug,
+        "paddle_tpu.trainer": pt.trainer,
+    }
+    for mod_name, mod in sorted(modules.items()):
+        names = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            try:
+                sig = str(inspect.signature(obj))
+            except (TypeError, ValueError):
+                sig = ""
+            kind = ("class" if inspect.isclass(obj)
+                    else "function" if callable(obj) else "value")
+            yield f"{mod_name}.{name} ({kind}{sig})"
+
+
+def main(out=sys.stdout):
+    for line in iter_api():
+        print(line, file=out)
+
+
+if __name__ == "__main__":
+    main()
