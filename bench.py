@@ -8,11 +8,18 @@ The baseline is the driver-set north star (BASELINE.json): BERT-base at
 >=35% MFU. ``vs_baseline`` therefore reports achieved-MFU / 0.35 so that
 1.0 == target met. MFU uses the standard 6N + 12*L*S*d transformer
 FLOPs-per-token estimate against the device's peak matmul FLOPs.
+
+Resilience: the axon TPU tunnel can be transiently UNAVAILABLE (observed
+round 1: backend init failed and the bench recorded rc=1, nothing else).
+Backend acquisition is therefore a bounded retry/backoff loop, falling back
+to a CPU smoke run, and ANY failure still emits a JSON line with an
+"error" field and exits 0.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -29,6 +36,9 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
 }
 
+# retry schedule for backend init (seconds between attempts; ~2.5 min total)
+BACKOFFS = [2, 5, 10, 20, 40, 60]
+
 
 def device_peak_flops(dev) -> float:
     kind = getattr(dev, "device_kind", "")
@@ -44,13 +54,67 @@ def count_params(tree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
-def main():
+def _probe_backend(timeout: float) -> str | None:
+    """Try TPU backend init in a SUBPROCESS with a hard timeout.
+
+    jax.devices() can HANG (not raise) when the axon tunnel is down, and a
+    blocked C call can't be interrupted in-process — so probe out-of-process
+    first. Returns None on success, error string on failure.
+    """
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=timeout, capture_output=True, text=True)
+        if r.returncode == 0:
+            return None
+        return f"probe rc={r.returncode}: {(r.stderr or '').strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        return f"probe hung >{timeout:.0f}s (axon tunnel unresponsive)"
+
+
+def acquire_device():
+    """Get a device with bounded retry/backoff; CPU fallback as last resort.
+
+    Returns (device, error_string_or_None). error is set when the TPU never
+    came up and we degraded to CPU.
+    """
+    last_err = None
+    for i, backoff in enumerate([0] + BACKOFFS):
+        if backoff:
+            print(f"[bench] backend init retry {i}/{len(BACKOFFS)} "
+                  f"in {backoff}s: {last_err}", file=sys.stderr)
+            time.sleep(backoff)
+        last_err = _probe_backend(timeout=180 if i == 0 else 90)
+        if last_err is None:
+            try:  # probe succeeded out-of-process; init here should be fast
+                return jax.devices()[0], None
+            except Exception as e:
+                last_err = f"{type(e).__name__}: {e}"
+                try:  # reset the cached failed-backend state before retrying
+                    from jax._src import xla_bridge
+                    xla_bridge._clear_backends()
+                except Exception:
+                    pass
+    # degrade to CPU so the run still records a number + the error.
+    # jax backends were never initialized in this process on the hang path,
+    # so the platform switch is still allowed.
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+        xla_bridge._clear_backends()
+        return jax.devices("cpu")[0], f"tpu unavailable, cpu fallback: {last_err}"
+    except Exception as e:
+        raise RuntimeError(f"no backend at all: {last_err} / {e}") from e
+
+
+def run_bench(dev):
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import dtypes
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
     from paddle_tpu.train import build_train_step, make_train_state
 
-    dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
     cfg = BertConfig.base(dropout=0.0, attn_dropout=0.0)
@@ -66,7 +130,9 @@ def main():
     state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
 
     def loss_fn(params, **batch):
-        return model.loss(params, training=False, **batch)
+        # training=True: bench the real training path (dropout=0 here, but
+        # keep the graph the one training uses)
+        return model.loss(params, training=True, **batch)
 
     policy = dtypes.get_policy("bf16") if on_tpu else None
     step = jax.jit(build_train_step(loss_fn, optimizer, policy=policy),
@@ -105,7 +171,7 @@ def main():
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / device_peak_flops(dev)
 
-    print(json.dumps({
+    return {
         "metric": "bert_base_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s/chip",
@@ -116,7 +182,25 @@ def main():
         "seq_len": seq,
         "params": n_params,
         "loss": round(final_loss, 4),
-    }))
+    }
+
+
+def main():
+    try:
+        dev, degraded = acquire_device()
+        result = run_bench(dev)
+        if degraded:
+            result["error"] = degraded
+            result["vs_baseline"] = 0.0
+    except Exception as e:  # fail-soft: always emit a parseable line, rc=0
+        result = {
+            "metric": "bert_base_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

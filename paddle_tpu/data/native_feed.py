@@ -91,6 +91,11 @@ class MultiSlotDataset:
                                          int(drop_last))
             if bs == 0:
                 return
+            if bs < 0:
+                err = self._lib.df_last_error(self._h)
+                raise RuntimeError(
+                    f"native data feed error (df_next_batch rc={int(bs)}): "
+                    f"{err.decode() if err else 'unknown'}")
             out: Dict[str, np.ndarray] = {}
             for i, (name, dtype) in enumerate(self.slots):
                 ml = self._lib.df_slot_maxlen(self._h, i)

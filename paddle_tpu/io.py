@@ -93,8 +93,13 @@ class CheckpointManager:
             enable_async_checkpointing=True)
         self.manager = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, state: Any, wait: bool = False):
-        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+    def save(self, step: int, state: Any, wait: bool = False,
+             force: bool = False):
+        """``force=True`` bypasses save_interval_steps gating — required for
+        the final end-of-fit save, which Orbax otherwise silently drops when
+        the last step is not on an interval boundary."""
+        self.manager.save(step, args=self._ocp.args.StandardSave(state),
+                          force=force)
         if wait:
             self.manager.wait_until_finished()
 

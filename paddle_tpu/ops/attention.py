@@ -62,6 +62,10 @@ def scaled_dot_product_attention(q, k, v, *, bias=None, causal=False,
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (every key at NEG_INF): emit 0, not the uniform mean
+    # of v — keeps this path consistent with the Pallas flash kernel
+    alive = jnp.max(s, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0)
     if dropout_rate > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
@@ -142,8 +146,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     @pl.when(ki == nk - 1)
     def _finish():
         denom = l_scr[...][:, :1]
-        denom = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows -> 0
-        o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        # fully-masked rows (every key at NEG_INF bias): m never rises above
+        # ~NEG_INF, p=exp(s-m)=1 and the naive result would be a uniform mean
+        # of v. Zero them so the forward matches the backward, which drops
+        # those rows' cotangents via the same lse <= NEG_INF/2 test.
+        alive = m_scr[...][:, :1] > NEG_INF / 2
+        o_ref[0] = jnp.where(alive, acc_scr[...] / denom, 0.0).astype(
+            o_ref.dtype)
         if lse_ref is not None:  # logsumexp row stats for the backward
             lse_ref[0, 0] = (m_scr[...][:, 0] + jnp.log(denom[:, 0]))
 

@@ -25,13 +25,27 @@ _ste_round.defvjp(lambda x: (jnp.round(x), None),
                   lambda _, g: (g,))  # straight-through
 
 
+@jax.custom_vjp
+def _ste_clip(v):
+    return jnp.clip(v, -1.0, 1.0)
+
+
+# closed-interval mask: the max-|x| element sits exactly at the boundary,
+# where jnp.clip's min/max tie-splitting would halve the gradient; the
+# reference pass-through semantics give it gradient 1.
+_ste_clip.defvjp(lambda v: (jnp.clip(v, -1.0, 1.0), v),
+                 lambda v, g: (g * (jnp.abs(v) <= 1.0).astype(g.dtype),))
+
+
 @register_op("fake_quantize_abs_max")
 def fake_quantize_abs_max(x, bit_length: int = 8):
     """Symmetric per-tensor fake quant with dynamic abs-max scale.
     Returns (quantized-dequantized x, scale)."""
     qmax = 2.0 ** (bit_length - 1) - 1
-    scale = jnp.maximum(jnp.abs(x).max(), 1e-8)
-    q = _ste_round(jnp.clip(x / scale, -1.0, 1.0) * qmax)
+    # scale is an observer, not a differentiable path: without stop_gradient
+    # the q*scale/qmax product leaks d(scale)/dx into the STE pass-through.
+    scale = jax.lax.stop_gradient(jnp.maximum(jnp.abs(x).max(), 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
     return q * scale / qmax, scale
 
 
@@ -40,9 +54,9 @@ def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8, axis: int = -1):
     """Per-channel symmetric fake quant (conv/linear weights)."""
     qmax = 2.0 ** (bit_length - 1) - 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
-    scale = jnp.maximum(jnp.abs(x).max(axis=reduce_axes, keepdims=True),
-                        1e-8)
-    q = _ste_round(jnp.clip(x / scale, -1.0, 1.0) * qmax)
+    scale = jax.lax.stop_gradient(
+        jnp.maximum(jnp.abs(x).max(axis=reduce_axes, keepdims=True), 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
     return q * scale / qmax, scale.squeeze()
 
 
@@ -59,8 +73,8 @@ def fake_quantize_moving_average_abs_max(x, state_scale, *,
         scale = momentum * state_scale + (1 - momentum) * cur
     else:
         scale = state_scale
-    scale = jnp.maximum(scale, 1e-8)
-    q = _ste_round(jnp.clip(x / scale, -1.0, 1.0) * qmax)
+    scale = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
     return q * scale / qmax, scale
 
 

@@ -79,6 +79,8 @@ class Trainer:
                 "each epoch gets a fresh pass over the data")
         last_metrics: Dict[str, float] = {}
         metrics: Dict[str, Any] = {}
+        # host-mirrored global step: one device sync here, none in the loop
+        gstep = self.step_count
         for epoch in range(epochs):
             it = make_iter() if make_iter is not None else data_iter
             t0 = time.perf_counter()
@@ -86,18 +88,23 @@ class Trainer:
             for batch in it:
                 self.state, metrics = self.train_step(self.state, **batch)
                 n += 1
-                step = None  # resolved lazily to avoid device sync per step
+                gstep += 1
                 if self.log_every and n % self.log_every == 0:
-                    step = self.step_count
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     rate = n / (time.perf_counter() - t0)
                     self.log_fn(
-                        f"[trainer] epoch {epoch} step {step} "
+                        f"[trainer] epoch {epoch} step {gstep} "
                         f"{_fmt(last_metrics)} ({rate:.1f} it/s)")
+                # gate on the GLOBAL step so epochs shorter than
+                # checkpoint_every still checkpoint across epochs
                 if self.manager is not None \
-                        and n % self.checkpoint_every == 0:
-                    step = self.step_count if step is None else step
-                    self.manager.save(step, jax.device_get(self.state))
+                        and gstep % self.checkpoint_every == 0:
+                    # label with the TRUE state step — gstep can drift ahead
+                    # when a step declines to increment (AMP overflow skips);
+                    # the sync is per-checkpoint, not per-step
+                    host_state = jax.device_get(self.state)
+                    gstep = int(host_state["step"])
+                    self.manager.save(gstep, host_state)
                 for hook in self.hooks:
                     hook(self, n, metrics)
                 if steps_per_epoch and n >= steps_per_epoch:
@@ -109,8 +116,12 @@ class Trainer:
             last_metrics = {k: float(v) for k, v in metrics.items()}
             self.log_fn(f"[trainer] epoch {epoch} done: {_fmt(last_metrics)}")
         if self.manager is not None:
-            self.manager.save(self.step_count,
-                              jax.device_get(self.state), wait=True)
+            last = self.step_count
+            if self.manager.latest_step() != last:
+                self.manager.save(last, jax.device_get(self.state),
+                                  wait=True, force=True)
+            else:
+                self.manager.wait()
         return last_metrics
 
     def evaluate(self, eval_step: Callable,

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import inspect
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -47,6 +48,10 @@ def iter_api():
                 continue
             try:
                 sig = str(inspect.signature(obj))
+                # repr() of callable/object defaults embeds memory addresses
+                # ("<function gelu at 0x7f...>") — strip to a stable form so
+                # the frozen spec reproduces across interpreters.
+                sig = re.sub(r" at 0x[0-9a-fA-F]+", "", sig)
             except (TypeError, ValueError):
                 sig = ""
             kind = ("class" if inspect.isclass(obj)
