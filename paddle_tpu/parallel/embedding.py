@@ -14,8 +14,11 @@ on-chip collective:
 - gradients flow through ``jnp.take`` (XLA scatter-add on the backward) —
   the ``SelectedRows`` sparse-grad machinery is subsumed by XLA.
 
-For tables beyond aggregate HBM the host-KV service (C++) is the planned
-escape hatch (SURVEY.md §7 step 8).
+For tables beyond aggregate HBM, the host-resident KV engine
+(``paddle_tpu/parallel/host_kv.py`` over ``native/kv_store.cc``) holds the
+table in host memory and the device step consumes pulled rows — see
+:func:`paddle_tpu.parallel.host_kv.fits_hbm` for the placement policy
+(SURVEY.md §7 step 8).
 """
 
 from __future__ import annotations

@@ -1,0 +1,319 @@
+"""Host-resident KV embedding engine tests (the PS/sparse world analog).
+
+Reference mapping: pslib sparse tables pulled/pushed per batch
+(fleet_wrapper.h:76 PullSparseVarsSync, :96 PushDenseVarsAsync), async
+delayed updates (communicator.h:166), and the composed CTR pipeline
+file -> MultiSlot feed -> sparse lookup -> train (DownpourWorker).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.deepfm import DeepFMHostKV
+from paddle_tpu.parallel.host_kv import (
+    HostKVEmbedding, HostKVStore, build_kv_train_step, fits_hbm,
+    run_kv_epoch)
+
+
+class TestHostKVStore:
+    def test_lazy_init_deterministic(self):
+        ids = np.array([1, 7, 1 << 40], np.int64)
+        a = HostKVStore(5, optimizer="sgd", init_scale=0.1, seed=3)
+        b = HostKVStore(5, optimizer="sgd", init_scale=0.1, seed=3)
+        np.testing.assert_array_equal(a.pull(ids), b.pull(ids))
+        c = HostKVStore(5, optimizer="sgd", init_scale=0.1, seed=4)
+        assert not np.allclose(a.pull(ids), c.pull(ids))
+        assert len(a) == 3
+        assert np.abs(a.pull(ids)).max() <= 0.1
+
+    def test_sgd_push(self):
+        s = HostKVStore(4, optimizer="sgd", init_scale=0.0)
+        ids = np.array([10, 20], np.int64)
+        g = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.float32)
+        s.push(ids, g, lr=0.5)
+        np.testing.assert_allclose(s.pull(ids), -0.5 * g)
+
+    def test_adagrad_push_matches_numpy(self):
+        s = HostKVStore(3, optimizer="adagrad", init_scale=0.0)
+        ids = np.array([42], np.int64)
+        w = np.zeros((1, 3), np.float32)
+        acc = np.zeros((1, 3), np.float32)
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            g = rng.normal(size=(1, 3)).astype(np.float32)
+            s.push(ids, g, lr=0.1)
+            acc += g * g
+            w -= 0.1 * g / (np.sqrt(acc) + 1e-8)
+        np.testing.assert_allclose(s.pull(ids), w, rtol=1e-5, atol=1e-6)
+
+    def test_async_pull_push_and_flush(self):
+        s = HostKVStore(8, optimizer="sgd", init_scale=0.0)
+        ids = np.arange(1000, dtype=np.int64)
+        h = s.pull_async(ids)
+        out = h.wait()
+        assert out.shape == (1000, 8)
+        s.push(ids, np.ones((1000, 8), np.float32), lr=1.0, wait=False)
+        s.flush()
+        np.testing.assert_allclose(s.pull(ids), -1.0)
+
+    def test_concurrent_pushes_accumulate(self):
+        # many async pushes to the same rows must all land (per-shard locks)
+        s = HostKVStore(2, optimizer="sgd", init_scale=0.0)
+        ids = np.array([0, 1, 2, 3], np.int64)
+        g = np.ones((4, 2), np.float32)
+        for _ in range(50):
+            s.push(ids, g, lr=0.1, wait=False)
+        s.flush()
+        np.testing.assert_allclose(s.pull(ids), -5.0, rtol=1e-4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        s = HostKVStore(6, optimizer="adagrad", init_scale=0.02, seed=1)
+        ids = np.array([5, 77, 1234567], np.int64)
+        s.push(ids, np.ones((3, 6), np.float32), lr=0.1)
+        path = os.path.join(tmp_path, "kv.bin")
+        s.save(path)
+        t = HostKVStore(6, optimizer="adagrad", init_scale=0.02, seed=1)
+        t.load(path)
+        # loaded rows match INCLUDING optimizer slots: one more identical
+        # push must produce identical results
+        s.push(ids, np.ones((3, 6), np.float32), lr=0.1)
+        t.push(ids, np.ones((3, 6), np.float32), lr=0.1)
+        np.testing.assert_allclose(t.pull(ids), s.pull(ids), rtol=1e-6)
+
+    def test_load_is_true_rollback(self, tmp_path):
+        s = HostKVStore(3, optimizer="sgd", init_scale=0.0)
+        s.push(np.array([1], np.int64), np.ones((1, 3), np.float32), 1.0)
+        path = os.path.join(tmp_path, "snap.kv")
+        s.save(path)
+        # rows created after the snapshot must be dropped by load
+        s.push(np.array([2], np.int64), np.ones((1, 3), np.float32), 1.0)
+        assert len(s) == 2
+        s.load(path)
+        assert len(s) == 1
+        np.testing.assert_allclose(s.pull(np.array([1], np.int64)), -1.0)
+
+    def test_dim_mismatch_load_rejected(self, tmp_path):
+        s = HostKVStore(4, optimizer="sgd")
+        path = os.path.join(tmp_path, "kv.bin")
+        s.save(path)
+        t = HostKVStore(5, optimizer="sgd")
+        with pytest.raises(IOError):
+            t.load(path)
+
+
+class TestHostKVEmbedding:
+    def test_lookup_dedup_and_padding(self):
+        s = HostKVStore(3, optimizer="sgd", init_scale=0.1, seed=0)
+        emb = HostKVEmbedding(s, min_bucket=8)
+        ids = np.array([[4, 4, 9], [9, 2, 4]], np.int64)
+        sb = emb.lookup_batch(ids)
+        assert sb.uniq.shape == (8,)            # bucketed
+        assert set(sb.uniq[:3]) == {2, 4, 9}
+        assert (sb.uniq[3:] == -1).all()
+        np.testing.assert_array_equal(sb.uniq[sb.inv], ids)
+        assert np.allclose(sb.rows[3:], 0.0)    # padding rows zero
+
+    def test_bucket_growth_bounded(self):
+        s = HostKVStore(2, optimizer="sgd")
+        emb = HostKVEmbedding(s, min_bucket=4)
+        sizes = set()
+        rng = np.random.default_rng(0)
+        for n in [1, 3, 4, 5, 9, 16, 17, 30]:
+            sb = emb.lookup_batch(rng.integers(0, 10**9, size=(n,)))
+            sizes.add(sb.rows.shape[0])
+        assert sizes <= {4, 8, 16, 32}          # log-bounded compile count
+
+    def test_apply_grads_skips_padding(self):
+        s = HostKVStore(2, optimizer="sgd", init_scale=0.0)
+        emb = HostKVEmbedding(s, lr=1.0, min_bucket=4)
+        sb = emb.lookup_batch(np.array([3, 8], np.int64))
+        g = np.full((4, 2), 2.0, np.float32)
+        emb.apply_grads(sb, g)
+        assert len(s) == 2                      # no row for id -1
+        np.testing.assert_allclose(s.pull(np.array([3, 8])), -2.0)
+
+
+class TestKVTrainParity:
+    """Sync host-KV training == dense on-device training, step for step.
+
+    The dense baseline holds the full (V, 1+D) table on device and updates
+    it with the same SGD rule; DeepFMHostKV with rows=T, inv=feat_ids is
+    exactly that model, so per-step losses and touched rows must agree.
+    """
+
+    def _setup(self, V=64, F=5, D=4):
+        model = DeepFMHostKV(num_fields=F, embed_dim=D, hidden=(16, 8))
+        params = model.init(jax.random.PRNGKey(0))
+        store = HostKVStore(1 + D, optimizer="sgd", init_scale=0.05, seed=9)
+        table0 = jnp.asarray(store.pull(np.arange(V, dtype=np.int64)))
+        return model, params, store, table0
+
+    def test_loss_and_rows_parity(self):
+        from paddle_tpu import optimizer as opt
+
+        V, F, D, B = 64, 5, 4, 16
+        lr = 0.05
+        model, params, store, table0 = self._setup(V, F, D)
+        optimizer = opt.SGD(learning_rate=lr)
+
+        # --- dense baseline: full table is a differentiable input
+        def dense_loss(params, table, feat_ids, label):
+            return model.loss(params, table, feat_ids, label)
+
+        dense_grad = jax.jit(jax.value_and_grad(
+            lambda p, t, i, y: dense_loss(p, t, i, y)[0], argnums=(0, 1)))
+
+        # --- kv path
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        kv_step = jax.jit(build_kv_train_step(
+            lambda p, rows, inv, label: model.loss(p, rows, inv, label),
+            optimizer))
+        emb = HostKVEmbedding(store, lr=lr, min_bucket=32)
+
+        d_params, d_table = params, table0
+        d_opt = optimizer.init(d_params)
+        rng = np.random.default_rng(1)
+        for step_i in range(6):
+            ids = rng.integers(0, V, size=(B, F)).astype(np.int64)
+            label = rng.integers(0, 2, size=(B,)).astype(np.float32)
+
+            loss_d, (gp, gt) = dense_grad(d_params, d_table, ids, label)
+            d_params, d_opt = optimizer.update(gp, d_opt, d_params)
+            d_table = d_table - lr * gt
+
+            sb = emb.lookup_batch(ids)
+            state, grad_rows, m = kv_step(
+                state, jnp.asarray(sb.rows), inv=jnp.asarray(sb.inv),
+                label=jnp.asarray(label))
+            emb.apply_grads(sb, np.asarray(grad_rows))
+
+            assert float(m["loss"]) == pytest.approx(float(loss_d),
+                                                     rel=1e-5), step_i
+
+        # touched rows converged identically
+        all_ids = np.arange(V, dtype=np.int64)
+        np.testing.assert_allclose(store.pull(all_ids),
+                                   np.asarray(d_table), rtol=1e-4,
+                                   atol=1e-6)
+        # dense tower params also agree
+        for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(state["params"]),
+                jax.tree_util.tree_leaves_with_path(d_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def _write_multislot_ctr(path, n_lines, V, max_f=6, seed=0):
+    """Ragged MultiSlot file: feat_ids (3..max_f ids) + label (1 float).
+
+    The first id is a "hot" feature in [0, 64) that determines the click
+    (hot < 32 -> 1), the tail ids are uniform cold features — so the hot
+    rows accumulate many sparse updates while the table stays huge."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            n = int(rng.integers(3, max_f + 1))
+            hot = int(rng.integers(0, 64))
+            ids = np.concatenate(
+                [[hot], rng.integers(64, V, size=(n - 1,))])
+            y = 1.0 if hot < 32 else 0.0
+            f.write(f"{n} " + " ".join(str(i) for i in ids)
+                    + f" 1 {y}\n")
+
+
+class TestComposedKVPipeline:
+    """file -> native MultiSlot feed (ragged) -> host-KV pull -> jitted
+    train step -> host push; the DownpourWorker CTR pipeline end to end."""
+
+    def _dataset(self, tmp_path, V, n=512):
+        from paddle_tpu.data.native_feed import MultiSlotDataset
+
+        p = os.path.join(tmp_path, "ctr.txt")
+        _write_multislot_ctr(p, n, V)
+        ds = MultiSlotDataset([("feat_ids", "int64"), ("label", "float32")])
+        ds.set_filelist([p])
+        assert ds.load_into_memory(num_threads=4) == n
+        ds.global_shuffle(seed=0)
+        return ds
+
+    def _batches(self, ds, batch_size):
+        for b in ds.batches(batch_size, with_lengths=True):
+            lens = b["feat_ids_len"]                  # ragged lengths
+            maxlen = b["feat_ids"].shape[1]
+            vals = (np.arange(maxlen)[None, :]
+                    < lens[:, None]).astype(np.float32)
+            yield dict(feat_ids=b["feat_ids"],
+                       feat_vals=jnp.asarray(vals),
+                       label=jnp.asarray(b["label"][:, 0]))
+
+    def test_deepfm_beyond_hbm_end_to_end(self, tmp_path):
+        from paddle_tpu import optimizer as opt
+
+        V, D = 50_000, 8
+        # the configured HBM budget rejects this table -> host KV world
+        assert not fits_hbm(V, 1 + D, budget_bytes=1 << 20)
+        store = HostKVStore(1 + D, optimizer="adagrad", init_scale=0.01,
+                            seed=0)
+        model = DeepFMHostKV(num_fields=6, embed_dim=D, hidden=(32, 16))
+        optimizer = opt.Adam(learning_rate=5e-3)
+        params = model.init(jax.random.PRNGKey(0))
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        step = jax.jit(build_kv_train_step(
+            lambda p, rows, inv, label, feat_vals: model.loss(
+                p, rows, inv, label, feat_vals), optimizer))
+        emb = HostKVEmbedding(store, lr=0.05, min_bucket=512)
+
+        ds = self._dataset(tmp_path, V)
+        losses = []
+        for _ in range(4):  # epochs with prefetch overlap
+            state, hist = run_kv_epoch(
+                step, state, emb, self._batches(ds, 64),
+                ids_key="feat_ids", prefetch=True)
+            losses.append(float(np.mean([float(m["loss"]) for m in hist])))
+        assert len(store) > 0
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] - 0.02, losses
+
+    def test_async_push_mode_trains(self, tmp_path):
+        from paddle_tpu import optimizer as opt
+
+        V, D = 10_000, 4
+        store = HostKVStore(1 + D, optimizer="adagrad", seed=0)
+        model = DeepFMHostKV(num_fields=6, embed_dim=D, hidden=(16,))
+        optimizer = opt.Adam(learning_rate=5e-3)
+        params = model.init(jax.random.PRNGKey(0))
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        step = jax.jit(build_kv_train_step(
+            lambda p, rows, inv, label, feat_vals: model.loss(
+                p, rows, inv, label, feat_vals), optimizer))
+        emb = HostKVEmbedding(store, lr=0.05, min_bucket=256)
+        ds = self._dataset(tmp_path, V, n=256)
+        state, hist = run_kv_epoch(
+            step, state, emb, self._batches(ds, 64),
+            ids_key="feat_ids", prefetch=True, async_push=True)
+        assert all(np.isfinite(float(m["loss"])) for m in hist)
+        assert len(store) > 0
+
+    def test_kv_checkpoint_roundtrip_in_pipeline(self, tmp_path):
+        store = HostKVStore(5, optimizer="adagrad", seed=0)
+        ids = np.array([3, 9], np.int64)
+        store.push(ids, np.ones((2, 5), np.float32), lr=0.1)
+        path = os.path.join(tmp_path, "table.kv")
+        store.save(path)
+        fresh = HostKVStore(5, optimizer="adagrad", seed=0)
+        fresh.load(path)
+        np.testing.assert_allclose(fresh.pull(ids), store.pull(ids))
+
+
+class TestPlacementPolicy:
+    def test_fits_hbm(self):
+        assert fits_hbm(10_000, 8, budget_bytes=10_000 * 8 * 4 * 3)
+        assert not fits_hbm(10_000, 8,
+                            budget_bytes=10_000 * 8 * 4 * 3 - 1)
