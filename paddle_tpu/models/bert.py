@@ -38,6 +38,12 @@ class BertConfig:
     attn_dropout: float = 0.1
     pre_ln: bool = False
     attn_impl: str = "auto"
+    # pipeline parallelism: run the encoder stack through the GPipe
+    # schedule over the "pp" mesh axis (parallel/pipeline.py), cutting the
+    # L layers into pp stages and streaming pp_microbatches through them.
+    # Embeddings/heads stay outside the pipelined middle.
+    pipeline: bool = False
+    pp_microbatches: int = 2
 
     @classmethod
     def base(cls, **kw):
@@ -114,11 +120,65 @@ class BertModel(Layer):
         x = self.embeddings(params["embeddings"], input_ids, token_type_ids,
                             key=keys[0], training=training)
         x = _constrain(x, ACT_SPEC)
-        for i, layer in enumerate(self.encoder):
-            x = layer(params["encoder"][str(i)], x, bias=bias,
-                      key=keys[i + 1], training=training)
+        if self.cfg.pipeline:
+            x = self._encoder_pipelined(params, x, bias, keys[1:], training)
+        else:
+            for i, layer in enumerate(self.encoder):
+                x = layer(params["encoder"][str(i)], x, bias=bias,
+                          key=keys[i + 1], training=training)
         pooled = jnp.tanh(self.pooler(params["pooler"], x[:, 0]))
         return x, pooled
+
+    def _encoder_pipelined(self, params, x, bias, layer_keys, training):
+        """GPipe the encoder stack over "pp" (PipelineOptimizer analog,
+        optimizer.py:2931): per-layer params are stacked to (L, ...) leaves
+        sharded over the stage axis; the attention bias and microbatch
+        index ride the ring with the activation (bias is per-microbatch;
+        the index folds into each layer's dropout key)."""
+        from paddle_tpu.parallel import pipeline as pp_lib
+
+        cfg = self.cfg
+        M = cfg.pp_microbatches
+        b, s, d = x.shape
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"pp_microbatches={M}")
+        stacked = pp_lib.stack_layer_params(
+            [params["encoder"][str(i)] for i in range(cfg.num_layers)])
+        has_keys = layer_keys[0] is not None
+        if has_keys:
+            stacked = (stacked, jnp.stack(layer_keys))
+        x_mb = x.reshape((M, b // M, s, d))
+        extras = None
+        if bias is not None:
+            extras = bias.reshape((M, b // M) + bias.shape[1:])
+
+        block_layer = self.encoder[0]  # identical structure for all layers
+
+        def block(lp, h, extra, mb_idx):
+            if has_keys:
+                layer_params, lkey = lp
+                k = jax.random.fold_in(lkey, mb_idx)
+                # decorrelate dropout masks across data-parallel shards:
+                # inside the shard_map the key is replicated, but each
+                # (dp, fsdp) shard holds different batch rows and must draw
+                # a different mask (the non-pipelined path draws over the
+                # global batch)
+                k = jax.random.fold_in(
+                    k, jax.lax.axis_index(("dp", "fsdp")))
+            else:
+                layer_params, k = lp, None
+            return block_layer(layer_params, h, bias=extra, key=k,
+                               training=training)
+
+        x_spec = P(None, ("dp", "fsdp"), None, None)
+        extras_spec = None
+        if extras is not None:
+            extras_spec = P(*((None, ("dp", "fsdp"))
+                              + (None,) * (extras.ndim - 2)))
+        out = pp_lib.gpipe(block, stacked, x_mb, extras=extras,
+                           x_spec=x_spec, extras_spec=extras_spec)
+        return out.reshape(b, s, d)
 
 
 class BertPretrainingHeads(Layer):

@@ -176,15 +176,29 @@ class HostKVStore:
 
 
 class PullHandle:
-    """An in-flight async pull; buffers are pinned here until wait()."""
+    """An in-flight async pull; buffers are pinned here until wait().
+
+    The native pool writes into ``ids``/``out`` directly, so an abandoned
+    handle must still wait before the buffers are garbage-collected —
+    ``__del__`` guarantees that (pushes copy their inputs; pulls do not).
+    """
 
     def __init__(self, store: HostKVStore, ticket: int, ids, out):
         self._store, self._ticket = store, ticket
         self._ids, self._out = ids, out
+        self._done = False
 
     def wait(self) -> np.ndarray:
-        self._store._lib.kv_wait(self._store._h, self._ticket)
+        if not self._done:
+            self._store._lib.kv_wait(self._store._h, self._ticket)
+            self._done = True
         return self._out
+
+    def __del__(self):
+        try:
+            self.wait()
+        except Exception:
+            pass  # store already torn down
 
 
 class SparseBatch(NamedTuple):
@@ -325,33 +339,25 @@ def run_kv_epoch(step_fn, state, emb: HostKVEmbedding, batches,
     import numpy as _np
 
     history = []
-    if not prefetch:
-        for batch in batches:
-            sb = emb.lookup_batch(batch[ids_key])
-            feed = {k: v for k, v in batch.items() if k != ids_key}
-            state, grad_rows, metrics = step_fn(
-                state, sb.rows, inv=sb.inv, **feed)
-            emb.apply_grads(sb, _np.asarray(grad_rows), wait=not async_push)
-            history.append(metrics)
-        if async_push:
-            emb.flush()
-        return state, history
-
     it = iter(batches)
     batch = next(it, None)
-    if batch is None:
-        return state, history
-    pf = emb.prefetch_batch(batch[ids_key])
+    pf = None
     while batch is not None:
-        nxt = next(it, None)
-        sb = pf.wait()
-        if nxt is not None:
-            pf = emb.prefetch_batch(nxt[ids_key])
+        nxt = next(it, None) if prefetch else None
+        if prefetch:
+            # this batch's pull was issued last iteration (or is the first)
+            sb = pf.wait() if pf is not None \
+                else emb.lookup_batch(batch[ids_key])
+            if nxt is not None:
+                pf = emb.prefetch_batch(nxt[ids_key])
+        else:
+            # strictly synchronous: pull AFTER the previous push landed
+            sb = emb.lookup_batch(batch[ids_key])
         feed = {k: v for k, v in batch.items() if k != ids_key}
         state, grad_rows, metrics = step_fn(
             state, sb.rows, inv=sb.inv, **feed)
         emb.apply_grads(sb, _np.asarray(grad_rows), wait=not async_push)
         history.append(metrics)
-        batch = nxt
+        batch = nxt if prefetch else next(it, None)
     emb.flush()
     return state, history

@@ -9,6 +9,13 @@ over "pp"; activations hop stages via ``lax.ppermute`` (ICI neighbor
 transfer), and autodiff through the loop yields the reverse pipeline, so
 one jitted train step contains the whole fwd+bwd schedule.
 
+Composition: the shard_map binds the FULL mesh, so the activation can stay
+sharded over (dp, fsdp) batch axes and the "sp" sequence axis via
+``x_spec`` while layers hop over "pp" (stage params are replicated over the
+other axes; their backward psums the grad contributions automatically).
+Per-microbatch side inputs (attention bias, the microbatch index for
+dropout PRNG folding) ride the ring alongside the activation.
+
 Constraint (same as scan-over-layers): pipelined blocks must be
 structurally identical — true for transformer stacks. Embedding/head run
 outside the pipelined middle.
@@ -33,20 +40,30 @@ def stack_layer_params(params_list):
 
 
 def gpipe(
-    block_fn: Callable[[Any, Any], Any],
+    block_fn: Callable,
     stacked_params: Any,
     x_microbatches,
     *,
+    extras: Any = None,
     mesh: Optional[Mesh] = None,
     axis: str = mesh_lib.PP,
     remat: bool = True,
+    x_spec: Optional[P] = None,
+    extras_spec: Any = None,
 ):
     """Run microbatches through a pipelined stack of identical blocks.
 
-    block_fn(layer_params, h) -> h; ``stacked_params`` leaves are
-    (L_total, ...) with L_total divisible by the "pp" axis size;
-    ``x_microbatches``: (M, mb, ...) microbatched activations.
-    Returns (M, mb, ...) outputs (replicated over "pp").
+    ``block_fn(layer_params, h, extra, mb_idx) -> h``; ``stacked_params``
+    leaves are (L_total, ...) with L_total divisible by the "pp" axis size;
+    ``x_microbatches``: (M, mb, ...) microbatched activations; ``extras``:
+    optional pytree of (M, ...) per-microbatch side inputs that travel the
+    ring with the activation (e.g. attention bias); ``mb_idx`` is the
+    traced int32 microbatch index (for dropout key folding).
+
+    ``x_spec``/``extras_spec``: PartitionSpecs for the (M, ...) arrays so
+    batch/sequence sharding over the other mesh axes is preserved inside
+    the pipeline (default: replicated). Returns (M, mb, ...) outputs
+    (replicated over "pp", sharded per ``x_spec`` elsewhere).
     """
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None:
@@ -55,41 +72,57 @@ def gpipe(
     M = x_microbatches.shape[0]
     if remat:
         block_fn = jax.checkpoint(block_fn)
+    x_spec = x_spec if x_spec is not None else P()
+    if extras_spec is None:
+        extras_spec = jax.tree_util.tree_map(lambda _: P(), extras)
 
-    def local_stage(local_params, h):
+    def local_stage(local_params, h, extra, mb):
         # apply this stage's L_total/n layers (scan over stacked leaves)
         def body(h, layer_params):
-            return block_fn(layer_params, h), None
+            return block_fn(layer_params, h, extra, mb), None
         h, _ = jax.lax.scan(body, h, local_params)
         return h
 
-    def stage_body(local_params, x):
+    def stage_body(local_params, x, extras):
         s = jax.lax.axis_index(axis)
         is_first = s == 0
         is_last = s == n - 1
         T = M + n - 1
         perm = [(i, i + 1) for i in range(n - 1)]
-        mb_shape = x.shape[1:]
-        received = jnp.zeros(mb_shape, x.dtype)
+        recv_h = jnp.zeros(x.shape[1:], x.dtype)
+        recv_e = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), extras)
+        recv_mb = jnp.zeros((), jnp.int32)
         outputs = jnp.zeros_like(x)
 
         def tick(t, carry):
-            received, outputs = carry
-            mb_idx = t - s
+            (recv_h, recv_e, recv_mb), outputs = carry
+            feed_at = jnp.clip(t, 0, M - 1)
+            feed_h = jax.lax.dynamic_index_in_dim(x, feed_at, keepdims=False)
+            feed_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, feed_at,
+                                                       keepdims=False),
+                extras)
+            inp_h = jnp.where(is_first, feed_h, recv_h)
+            inp_e = jax.tree_util.tree_map(
+                lambda f, r: jnp.where(is_first, f, r), feed_e, recv_e)
+            inp_mb = jnp.where(is_first, feed_at, recv_mb)
+            h = local_stage(local_params, inp_h, inp_e, inp_mb)
+            mb_idx = t - s          # microbatch this stage just computed
             active = (mb_idx >= 0) & (mb_idx < M)
-            feed = jax.lax.dynamic_index_in_dim(
-                x, jnp.clip(t, 0, M - 1), keepdims=False)
-            inp = jnp.where(is_first, feed, received)
-            h = local_stage(local_params, inp)
             write_at = jnp.clip(mb_idx, 0, M - 1)
             prev = jax.lax.dynamic_index_in_dim(outputs, write_at,
                                                 keepdims=False)
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(active & is_last, h, prev), write_at, 0)
-            received = jax.lax.ppermute(h, axis, perm)
-            return received, outputs
+            recv_h = jax.lax.ppermute(h, axis, perm)
+            recv_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis, perm), inp_e)
+            recv_mb = jax.lax.ppermute(inp_mb, axis, perm)
+            return ((recv_h, recv_e, recv_mb), outputs)
 
-        _, outputs = jax.lax.fori_loop(0, T, tick, (received, outputs))
+        _, outputs = jax.lax.fori_loop(
+            0, T, tick, ((recv_h, recv_e, recv_mb), outputs))
         # outputs are only valid on the last stage: replicate via psum
         outputs = jnp.where(is_last, outputs, 0.0)
         return jax.lax.psum(outputs, axis)
@@ -97,10 +130,10 @@ def gpipe(
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     return jax.shard_map(
         stage_body, mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec, extras_spec),
+        out_specs=x_spec,
         check_vma=False,
-    )(stacked_params, x_microbatches)
+    )(stacked_params, x_microbatches, extras)
 
 
 def microbatch(batch, num_microbatches: int):

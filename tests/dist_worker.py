@@ -1,0 +1,147 @@
+"""Subprocess worker for multi-process distributed tests.
+
+The reference proves distribution by spawning real localhost trainer
+processes and asserting loss parity with a single-process run
+(test_dist_base.py:461 start_local_trainers, :629 _run_cluster, :828
+check_with_place delta assert). This worker is the TPU-native analog:
+``fleet.init`` -> ``jax.distributed.initialize`` (CPU backend, Gloo
+collectives), a dp mesh over the global devices, and the standard
+paddle_tpu sharded train step on deterministic synthetic data.
+
+Modes:
+  parity: run N steps, write per-step losses to --out (JSON).
+  stall:  like parity but slow; if --die-at >= 0, this rank exits hard at
+          that step (simulated worker crash). Survivors detect the failure
+          via HeartbeatMonitor (fleet.py) or the JAX coordination error and
+          record it — the failure-detection path under test.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--mode", choices=["parity", "stall"], default="parity")
+    ap.add_argument("--die-at", type=int, default=-1)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from paddle_tpu import fleet
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
+    from paddle_tpu.parallel import api as papi
+    from paddle_tpu.train import build_train_step, make_train_state
+
+    role = fleet.RoleMaker(args.rank, args.nproc,
+                           coordinator=f"localhost:{args.port}")
+    fleet.init(role)
+    assert jax.process_index() == args.rank
+    ndev = jax.device_count()
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.nn.layers import Linear
+    from paddle_tpu.nn.module import Layer
+
+    class MLP(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = Linear(8, 16, sharding=None)
+            self.fc2 = Linear(16, 1, sharding=None)
+
+        def forward(self, params, x):
+            return self.fc2(params["fc2"],
+                            jnp.tanh(self.fc1(params["fc1"], x)))[:, 0]
+
+        def loss(self, params, x, y):
+            pred = self.forward(params, x)
+            return ((pred - y) ** 2).mean()
+
+    model = MLP()
+    optimizer = opt.SGD(learning_rate=0.1)
+    mesh = make_mesh(MeshConfig(dp=ndev))
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    GLOBAL_BATCH = 8
+
+    def global_batch(step):
+        rng = np.random.default_rng(1000 + step)  # same on every worker
+        x = rng.normal(size=(GLOBAL_BATCH, 8)).astype(np.float32)
+        y = (x[:, 0] * 0.5 - x[:, 1] ** 2 * 0.1).astype(np.float32)
+        return {"x": x, "y": y}
+
+    def to_device(host_batch):
+        """host global batch -> sharded global jax.Arrays (each process
+        contributes its local shard, fleet.local_shard picks it)."""
+        local = fleet.local_shard(host_batch)
+        return {
+            k: jax.make_array_from_process_local_data(
+                batch_sharding, v, (GLOBAL_BATCH,) + v.shape[1:])
+            for k, v in local.items()
+        }
+
+    out = {"rank": args.rank, "losses": [], "events": []}
+
+    def flush(code=0):
+        with open(args.out, "w") as f:
+            json.dump(out, f)
+        sys.stdout.flush()
+        os._exit(code)
+
+    stall_seen = {}
+
+    def on_stall(step, idle):
+        out["events"].append({"kind": "stall_detected", "step": int(step),
+                              "idle_s": float(idle)})
+        stall_seen["yes"] = True
+        flush(3)
+
+    monitor = None
+    if args.mode == "stall":
+        monitor = fleet.HeartbeatMonitor(timeout_s=5.0, check_every_s=0.5,
+                                         on_stall=on_stall,
+                                         log_fn=lambda m: None)
+
+    with mesh_context(mesh):
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        step_fn = build_train_step(
+            lambda params, x, y: model.loss(params, x, y), optimizer)
+        run, placed = papi.shard_train_step(
+            step_fn, mesh, state, batch_spec=P(("dp", "fsdp")))
+        state = placed
+        try:
+            for i in range(args.steps):
+                if args.mode == "stall" and args.rank > 0 \
+                        and i == args.die_at:
+                    os._exit(9)  # simulated crash, no cleanup
+                batch = to_device(global_batch(i))
+                state, metrics = run(state, **batch)
+                loss = float(metrics["loss"])  # device sync point
+                out["losses"].append(loss)
+                if monitor is not None:
+                    monitor.beat(i)
+                    time.sleep(0.3)  # give the parent time to observe
+        except Exception as e:  # peer death surfaces as a collective error
+            out["events"].append({"kind": "peer_failure",
+                                  "error": f"{type(e).__name__}: {e}"[:300]})
+            flush(4)
+    flush(0)
+
+
+if __name__ == "__main__":
+    main()

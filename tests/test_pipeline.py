@@ -20,8 +20,11 @@ def pp_mesh():
     return make_mesh(MeshConfig(pp=4, dp=2))
 
 
-def _block(params, h):
-    return jnp.tanh(h @ params["w"] + params["b"])
+def _block(params, h, extra=None, mb_idx=None):
+    h = jnp.tanh(h @ params["w"] + params["b"])
+    if extra is not None:
+        h = h + extra
+    return h
 
 
 def _make_layers(key, n_layers, dim):
@@ -71,6 +74,43 @@ class TestGPipe:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
+    def test_extras_ride_the_ring(self, pp_mesh):
+        """Per-microbatch side inputs (attention-bias analog) must follow
+        their microbatch through every stage."""
+        layers = _make_layers(jax.random.PRNGKey(7), 4, 8)
+        stacked = stack_layer_params(layers)
+        x = jax.random.normal(jax.random.PRNGKey(8), (6, 2, 8))
+        extra = jax.random.normal(jax.random.PRNGKey(9), (6, 2, 8))
+
+        ref = x
+        for p in layers:
+            ref = _block(p, ref, extra)
+
+        with mesh_context(pp_mesh):
+            out = jax.jit(lambda sp, x, e: gpipe(
+                _block, sp, x, extras=e, mesh=pp_mesh))(stacked, x, extra)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_mb_idx_tracks_microbatch(self, pp_mesh):
+        """The microbatch index delivered to the block must equal the true
+        index of the microbatch being computed (dropout-PRNG contract)."""
+        layers = _make_layers(jax.random.PRNGKey(0), 4, 4)
+        stacked = stack_layer_params(layers)
+        M = 6
+        x = jnp.zeros((M, 1, 4))
+
+        def block(p, h, extra, mb_idx):
+            # write the index into the activation; every stage adds it, so
+            # output = 4 * mb_idx if indices are delivered correctly
+            return h + mb_idx.astype(h.dtype)
+
+        with mesh_context(pp_mesh):
+            out = jax.jit(lambda sp, x: gpipe(
+                block, sp, x, mesh=pp_mesh))(stacked, x)
+        expect = 4.0 * jnp.arange(M).reshape(M, 1, 1) * jnp.ones((M, 1, 4))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect))
+
     def test_microbatch_roundtrip(self):
         batch = {"x": jnp.arange(24.0).reshape(12, 2)}
         mb = microbatch(batch, 4)
@@ -79,6 +119,98 @@ class TestGPipe:
         np.testing.assert_allclose(np.asarray(back["x"]),
                                    np.asarray(batch["x"]))
 
+class TestBertPipelined:
+    """BERT with the encoder run through gpipe over "pp", composed with
+    dp+fsdp batch sharding — loss/grad parity vs the sequential encoder."""
+
+    CFG = dict(vocab_size=64, hidden_size=16, num_layers=4, num_heads=2,
+               ffn_size=32, max_position=32, dropout=0.0, attn_dropout=0.0,
+               attn_impl="xla")
+
+    def _models_and_batch(self):
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+        m_ref = BertForPretraining(BertConfig.tiny(**self.CFG))
+        m_pp = BertForPretraining(BertConfig.tiny(
+            **self.CFG, pipeline=True, pp_microbatches=4))
+        params = m_ref.init(jax.random.PRNGKey(0))
+        b, s = 16, 16
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        mask = jnp.arange(s)[None, :] < jax.random.randint(
+            k2, (b, 1), s // 2, s + 1)           # ragged padding
+        batch = dict(
+            input_ids=jax.random.randint(k1, (b, s), 0, 64, jnp.int32),
+            token_type_ids=jnp.zeros((b, s), jnp.int32),
+            attention_mask=mask,
+            mlm_labels=jnp.zeros((b, s), jnp.int32),
+            mlm_mask=jnp.ones((b, s), jnp.float32),
+            nsp_labels=jnp.zeros((b,), jnp.int32),
+        )
+        return m_ref, m_pp, params, batch
+
+    def test_loss_and_grad_parity_pp_dp_fsdp(self):
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, pp=2))
+        m_ref, m_pp, params, batch = self._models_and_batch()
+
+        def loss_ref(p):
+            return m_ref.loss(p, training=False, **batch)[0]
+
+        def loss_pp(p):
+            return m_pp.loss(p, training=False, **batch)[0]
+
+        l_ref, g_ref = jax.value_and_grad(loss_ref)(params)
+        with mesh_context(mesh):
+            l_pp, g_pp = jax.jit(jax.value_and_grad(loss_pp))(params)
+        assert float(l_pp) == pytest.approx(float(l_ref), rel=1e-5)
+        for a, b_ in zip(jax.tree_util.tree_leaves(g_pp),
+                         jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=1e-3)
+
+    def test_dropout_under_pipeline(self):
+        """training=True with dropout>0 exercises the per-layer key ride
+        (fold_in of the microbatch index) inside the schedule."""
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+        cfg = dict(self.CFG, dropout=0.3)
+        m = BertForPretraining(BertConfig.tiny(
+            **cfg, pipeline=True, pp_microbatches=4))
+        params = m.init(jax.random.PRNGKey(0))
+        _, _, _, batch = self._models_and_batch()
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, pp=2))
+        with mesh_context(mesh):
+            f = jax.jit(lambda p, k: m.loss(
+                p, training=True, key=k, **batch)[0])
+            l1 = float(f(params, jax.random.PRNGKey(1)))
+            l2 = float(f(params, jax.random.PRNGKey(2)))
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l1 != l2  # dropout really sampled
+
+    def test_pp_composes_with_tp(self):
+        """pp=2 x tp=2: stage params replicated over tp, attention/FFN
+        constraints inert inside the shard_map — result must still match
+        the sequential reference."""
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, tp=2, pp=2))
+        m_ref, m_pp, params, batch = self._models_and_batch()
+
+        def loss_ref(p):
+            return m_ref.loss(p, training=False, **batch)[0]
+
+        def loss_pp(p):
+            return m_pp.loss(p, training=False, **batch)[0]
+
+        l_ref = float(loss_ref(params))
+        with mesh_context(mesh):
+            l_pp = float(jax.jit(loss_pp)(params))
+        assert l_pp == pytest.approx(l_ref, rel=1e-5)
+
+
+class TestGPipeTraining:
     def test_train_step_through_pipeline(self, pp_mesh):
         """End-to-end: pipelined MLP regression learns under jit."""
         layers = _make_layers(jax.random.PRNGKey(4), 4, 8)
