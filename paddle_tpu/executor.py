@@ -47,9 +47,17 @@ class Program:
     # Sharding: feed arrays get batch sharding over (dp, fsdp) unless listed
     # in `replicated_feeds`.
     replicated_feeds: Sequence[str] = ()
+    # Placement for the state argument under a mesh: a pytree of
+    # PartitionSpecs or NamedShardings matching the state (e.g. from
+    # ShardingPlan.state_specs). Part of the Program — the reference's
+    # ProgramDesc likewise carries placement — so Executor.run uses it
+    # without extra plumbing.
+    state_shardings: Any = None
 
     def compile(self, mesh: Optional[Mesh] = None,
                 state_shardings: Any = None) -> "CompiledProgram":
+        if state_shardings is None:
+            state_shardings = self.state_shardings
         return CompiledProgram(self, mesh, state_shardings)
 
 
@@ -65,12 +73,24 @@ class CompiledProgram:
         self._replicated = (mesh_lib.replicated(mesh)
                             if mesh is not None else None)
         donate = (0,) if program.donate_state else ()
+        self.state_shardings = None
         if mesh is not None and state_shardings is not None:
-            in_shardings = (state_shardings,)
-            self._fn = jax.jit(program.fn, donate_argnums=donate,
-                               in_shardings=in_shardings)
-        else:
-            self._fn = jax.jit(program.fn, donate_argnums=donate)
+            # accept PartitionSpec leaves and bind them to the mesh
+            self.state_shardings = jax.tree_util.tree_map(
+                lambda s: (NamedSharding(mesh, s)
+                           if isinstance(s, P) else s),
+                state_shardings,
+                is_leaf=lambda x: isinstance(x, (P, NamedSharding)))
+        elif mesh is not None and mesh.size > 1:
+            import warnings
+            warnings.warn(
+                f"Program '{program.name}' compiled for a {mesh.size}-"
+                "device mesh WITHOUT state_shardings: the state will be "
+                "fully replicated on every device. Pass "
+                "Program(state_shardings=...) (e.g. from "
+                "ShardingPlan.state_specs) to shard it.",
+                stacklevel=3)
+        self._fn = jax.jit(program.fn, donate_argnums=donate)
 
     def __call__(self, state, **feeds):
         if self.mesh is not None:
@@ -81,6 +101,11 @@ class CompiledProgram:
                     else self._batch_sharding)
                 for k, v in feeds.items()
             }
+            if self.state_shardings is not None and state is not None:
+                # committed placement drives GSPMD; a no-op when the state
+                # already sits on these shardings (the steady-state train
+                # loop: donated outputs come back correctly placed)
+                state = jax.device_put(state, self.state_shardings)
         return self._fn(state, **feeds)
 
 

@@ -97,14 +97,170 @@ def _ring_attention_local(q, k, v, bias, *, axis, scale, causal):
     return (acc / denom).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas-backed ring attention: flash kernel per visiting block
+# ---------------------------------------------------------------------------
+#
+# The composed path above materializes fp32 (B,H,Sl,Sl) score blocks per
+# ring step; at long context that caps MFU on HBM bandwidth. The flash path
+# keeps flash-level arithmetic intensity: each ring step runs the Pallas
+# forward kernel on (q_local, k_visiting) returning a NORMALIZED block
+# output plus its logsumexp, and blocks merge with the streaming
+# logaddexp recurrence:
+#     lse'   = logaddexp(lse, lse_blk)
+#     out'   = out * exp(lse - lse') + out_blk * exp(lse_blk - lse')
+# The whole per-device ring is ONE custom_vjp: the backward re-rotates
+# k/v around the ring with their grad accumulators, running the Pallas
+# FA2 backward kernels per block against the GLOBAL lse (so recomputed
+# probabilities match the merged forward exactly).
+
+
+def _ring_flash_case(idx, src, n):
+    """0 = diagonal block (causal masking inside), 1 = fully visible,
+    2 = fully masked (skip)."""
+    return jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+
+
+def _make_ring_flash(axis: str, scale: float, causal: bool,
+                     interpret: bool):
+    from paddle_tpu.ops import attention as A
+
+    def fwd_block(q, k, v, bias, case):
+        b, h, sl, d = q.shape
+
+        def diag(q, k, v, bias):
+            return A._flash_fwd(q, k, v, bias, scale=scale, causal=True,
+                                block_q=512, block_k=512,
+                                interpret=interpret, return_lse=True)
+
+        def full(q, k, v, bias):
+            return A._flash_fwd(q, k, v, bias, scale=scale, causal=False,
+                                block_q=512, block_k=512,
+                                interpret=interpret, return_lse=True)
+
+        def skip(q, k, v, bias):
+            return (jnp.zeros((b, h, sl, d), q.dtype),
+                    jnp.full((b, h, sl), NEG_INF, jnp.float32))
+
+        if not causal:
+            return full(q, k, v, bias)
+        return jax.lax.switch(case, [diag, full, skip], q, k, v, bias)
+
+    def bwd_block(q, k, v, bias, out, lse, g, case):
+        def diag(q, k, v, bias, out, lse, g):
+            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
+                                causal=True, block_q=512, block_k=512,
+                                interpret=interpret)
+
+        def full(q, k, v, bias, out, lse, g):
+            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
+                                causal=False, block_q=512, block_k=512,
+                                interpret=interpret)
+
+        def skip(q, k, v, bias, out, lse, g):
+            return (jnp.zeros_like(q), jnp.zeros_like(k),
+                    jnp.zeros_like(v))
+
+        if not causal:
+            return full(q, k, v, bias, out, lse, g)
+        return jax.lax.switch(case, [diag, full, skip],
+                              q, k, v, bias, out, lse, g)
+
+    @jax.custom_vjp
+    def ring_flash_local(q, k, v, bias):
+        out, _ = _ring_flash_fwd(q, k, v, bias)
+        return out
+
+    def _rot(x, perm):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.ppermute(a, axis, perm), x)
+
+    def _ring_flash_fwd(q, k, v, bias):
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        b, h, sl, d = q.shape
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        out = jnp.zeros((b, h, sl, d), jnp.float32)
+        lse = jnp.full((b, h, sl), NEG_INF, jnp.float32)
+
+        def step(i, carry):
+            out, lse, k, v, bias = carry
+            src = jax.lax.rem(idx - i + n, n)
+            o_blk, lse_blk = fwd_block(
+                q, k, v, bias, _ring_flash_case(idx, src, n))
+            lse_new = jnp.logaddexp(lse, lse_blk)
+            # guard fully-masked rows: both weights would be exp(NEG_INF -
+            # NEG_INF-ish) garbage; forcing weights to 0 keeps out at 0
+            alive = lse_new > NEG_INF / 2
+            w_old = jnp.where(alive, jnp.exp(lse - lse_new), 0.0)
+            w_blk = jnp.where(alive, jnp.exp(lse_blk - lse_new), 0.0)
+            out = out * w_old[..., None] \
+                + o_blk.astype(jnp.float32) * w_blk[..., None]
+            k, v, bias = _rot((k, v, bias), perm)
+            return out, lse_new, k, v, bias
+
+        out, lse, _, _, _ = jax.lax.fori_loop(
+            0, n, step, (out, lse, k, v, bias))
+        return out.astype(q.dtype), lse
+
+    def vjp_fwd(q, k, v, bias):
+        out, lse = _ring_flash_fwd(q, k, v, bias)
+        return out, (q, k, v, bias, out, lse)
+
+    def vjp_bwd(res, g):
+        q, k, v, bias, out, lse = res
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # fp32 accumulators: each ring step adds a partial; rounding to the
+        # input dtype per step would degrade grads as sp grows (the
+        # single-device kernel accumulates in fp32 scratch and rounds once)
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dk = jnp.zeros(k.shape, jnp.float32)
+        dv = jnp.zeros(v.shape, jnp.float32)
+
+        def step(i, carry):
+            dq, k, v, bias, dk, dv = carry
+            src = jax.lax.rem(idx - i + n, n)
+            dq_blk, dk_blk, dv_blk = bwd_block(
+                q, k, v, bias, out, lse, g,
+                _ring_flash_case(idx, src, n))
+            dq = dq + dq_blk.astype(jnp.float32)
+            dk = dk + dk_blk.astype(jnp.float32)
+            dv = dv + dv_blk.astype(jnp.float32)
+            # grads rotate WITH their block: after n hops they are home
+            k, v, bias, dk, dv = _rot((k, v, bias, dk, dv), perm)
+            return dq, k, v, bias, dk, dv
+
+        dq, _, _, _, dk, dv = jax.lax.fori_loop(
+            0, n, step, (dq, k, v, bias, dk, dv))
+        # key-padding bias is a constant mask (flash_attention convention;
+        # ring_attention stop-gradients bias for BOTH impls)
+        dbias = jnp.zeros_like(bias) if bias is not None else None
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), \
+            dbias
+
+    ring_flash_local.defvjp(vjp_fwd, vjp_bwd)
+    return ring_flash_local
+
+
 def ring_attention(q, k, v, *, bias=None, causal=False,
                    scale: Optional[float] = None,
-                   axis: str = mesh_lib.SP, mesh: Optional[Mesh] = None):
+                   axis: str = mesh_lib.SP, mesh: Optional[Mesh] = None,
+                   impl: str = "auto"):
     """Sequence-parallel attention. q,k,v: (B,H,S,D) with S sharded over
     ``axis``; ``bias`` optional key-padding bias (B,1,1,S) sharded on S.
 
+    ``impl``: "xla" (composed online-softmax blocks), "flash" (Pallas
+    kernel per ring block — flash-level arithmetic intensity under sp>1),
+    "flash_interpret" (tests on CPU), "auto" (flash on TPU, xla elsewhere).
     Must run under a mesh (pjit/jit with mesh context). Returns (B,H,S,D)
     with the same sharding as q.
+
+    ``bias`` is a CONSTANT mask: it is stop-gradiented on every impl (the
+    flash kernels do not produce bias cotangents; stopping it on the xla
+    path too keeps gradients backend-independent). Trainable attention
+    biases are incompatible with sequence-parallel ring attention here.
     """
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None:
@@ -112,12 +268,30 @@ def ring_attention(q, k, v, *, bias=None, causal=False,
                          "(use mesh_context or pass mesh=)")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None:
+        bias = jax.lax.stop_gradient(bias)
+    if impl == "auto":
+        from paddle_tpu.ops.attention import _on_tpu, pltpu
+        impl = "flash" if (pltpu is not None and _on_tpu()) else "xla"
 
     qkv_spec = P(mesh_lib.BATCH_AXES, mesh_lib.TP, axis, None)
     bias_spec = P(mesh_lib.BATCH_AXES, None, None, axis)
     in_specs = (qkv_spec, qkv_spec, qkv_spec)
     args = (q, k, v)
-    if bias is not None:
+
+    if impl in ("flash", "flash_interpret"):
+        local = _make_ring_flash(axis, scale, causal,
+                                 interpret=impl == "flash_interpret")
+        if bias is not None:
+            in_specs = in_specs + (bias_spec,)
+            args = args + (bias,)
+
+            def body(q, k, v, bias):
+                return local(q, k, v, bias)
+        else:
+            def body(q, k, v):
+                return local(q, k, v, None)
+    elif bias is not None:
         in_specs = in_specs + (bias_spec,)
         args = args + (bias,)
 

@@ -103,12 +103,9 @@ def main():
         sys.stdout.flush()
         os._exit(code)
 
-    stall_seen = {}
-
     def on_stall(step, idle):
         out["events"].append({"kind": "stall_detected", "step": int(step),
                               "idle_s": float(idle)})
-        stall_seen["yes"] = True
         flush(3)
 
     monitor = None

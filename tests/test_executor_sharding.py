@@ -1,0 +1,81 @@
+"""Executor + mesh placement tests: a mesh'd Executor.run must actually
+shard the state per plan (CompiledProgram.with_data_parallel parity —
+the reference broadcasts/places params per device builder decisions;
+replicating silently is the bug under test)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.mesh import MeshConfig, make_mesh
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer
+
+
+class _MLP(Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = Linear(16, 64, sharding=None)
+        self.fc2 = Linear(64, 4, sharding=None)
+
+    def forward(self, params, x):
+        return self.fc2(params["fc2"], jnp.tanh(self.fc1(params["fc1"], x)))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig(dp=2, fsdp=4))
+
+
+class TestExecutorSharding:
+    def test_state_shardings_are_applied(self, mesh):
+        from paddle_tpu.parallel import plan as plan_lib
+
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        plan = plan_lib.fsdp_plan(min_size=16)
+        specs = plan.params_specs(params, model.sharding_specs(params))
+
+        program = pt.Program(fn=lambda p, x: model(p, x), name="infer",
+                             state_shardings=specs)
+        exe = pt.Executor(mesh=mesh)
+        x = jnp.ones((8, 16))
+        _, out = exe.run(program, params, feed={"x": x})
+        assert out.shape == (8, 4)
+
+        # the compiled program really placed the params per plan: fc1
+        # weight (16, 64) is large enough for the fsdp plan to shard
+        compiled = exe._cache[id(program)][1]
+        sh = jax.tree_util.tree_leaves(
+            compiled.state_shardings,
+            is_leaf=lambda s: hasattr(s, "spec"))
+        assert any("fsdp" in str(s.spec) for s in sh), \
+            [str(s.spec) for s in sh]
+
+        # run again through the cache: placement must persist
+        _, out2 = exe.run(program, params, feed={"x": x})
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+    def test_mesh_without_shardings_warns(self, mesh):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        program = pt.Program(fn=lambda p, x: model(p, x), name="naked")
+        exe = pt.Executor(mesh=mesh)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(program, params, feed={"x": jnp.ones((8, 16))})
+        assert any("WITHOUT state_shardings" in str(x.message) for x in w)
+
+    def test_single_device_no_warning(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        program = pt.Program(fn=lambda p, x: model(p, x))
+        exe = pt.Executor()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(program, params, feed={"x": jnp.ones((8, 16))})
+        assert not [x for x in w if "state_shardings" in str(x.message)]
