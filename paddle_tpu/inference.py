@@ -7,9 +7,12 @@ Reference mapping (SURVEY.md §2.7):
   StableHLO (``jax.export``) + the param pytree. The StableHLO artifact is
   the ``__model__`` analog: loadable without the Python model class.
 - ``AnalysisPredictor`` (api/analysis_predictor.h:47 — load, run analysis
-  passes, zero-copy run loop) → :class:`Predictor`. XLA replaces the
-  analysis pass pipeline (fuse passes ≙ XLA fusion; memory_optimize ≙
-  buffer assignment); a C++ PJRT runner is the planned native serving shell.
+  passes, zero-copy run loop) → :class:`Predictor` (in-process) and the
+  C++ native serving shell :class:`paddle_tpu.native.pjrt.NativePredictor`
+  (``native/pjrt_runner.cc``: dlopen a PJRT C-API plugin, compile the
+  frozen StableHLO once, serve over a C ABI — the capi/ analog). XLA
+  replaces the analysis pass pipeline (fuse passes ≙ XLA fusion;
+  memory_optimize ≙ buffer assignment).
 """
 
 from __future__ import annotations
@@ -32,31 +35,72 @@ _META_FILE = "meta.json"
 
 def save_inference_model(path: str, fn, params: Any,
                          example_inputs: Sequence[Any],
-                         input_names: Optional[Sequence[str]] = None):
+                         input_names: Optional[Sequence[str]] = None,
+                         freeze_native: bool = True,
+                         platforms: Optional[Sequence[str]] = None):
     """Export ``fn(params, *inputs)`` for serving.
 
-    Writes three artifacts into ``path`` (a directory):
-      __model__.stablehlo  portable serialized StableHLO (vm-agnostic)
-      params.pkl           host copy of the param pytree
-      meta.json            input names/shapes/dtypes (the feed contract)
+    Writes into ``path`` (a directory):
+      __model__.stablehlo         portable serialized export (vm-agnostic)
+      params.pkl                  host copy of the param pytree
+      meta.json                   input/output names/shapes/dtypes
+    and, with ``freeze_native`` (for the C++ PJRT runner):
+      __model__frozen__.stablehlo raw StableHLO bytecode with the params
+                                  BAKED IN as constants (inputs-only main —
+                                  the frozen-program serving convention;
+                                  the reference's save_inference_model
+                                  likewise prunes to a feed/fetch program)
+      compile_options.pb          serialized XLA CompileOptionsProto
+
+    ``platforms``: lowering platforms for the export (e.g. ["tpu"] to
+    export a serving artifact for TPU from a CPU dev host). Default: the
+    current backend. The frozen native artifact requires a SINGLE
+    platform (a multi-platform module takes a platform-index argument
+    the C++ runner does not feed).
     """
     os.makedirs(path, exist_ok=True)
+    if platforms is not None and freeze_native and len(platforms) != 1:
+        raise ValueError("freeze_native requires exactly one platform; "
+                         f"got {platforms}")
 
     def fwd(params, *inputs):
         return fn(params, *inputs)
 
-    exp = jax_export.export(jax.jit(fwd))(params, *example_inputs)
+    exp = jax_export.export(jax.jit(fwd), platforms=platforms)(
+        params, *example_inputs)
     with open(os.path.join(path, _MODEL_FILE), "wb") as f:
         f.write(exp.serialize())
     io_lib.save_params(params, os.path.join(path, _PARAMS_FILE))
     names = list(input_names or
                  [f"x{i}" for i in range(len(example_inputs))])
+    out_leaves = list(exp.out_avals)  # flattened, no extra trace
     meta = {
         "input_names": names,
         "inputs": [{"shape": list(np.shape(a)),
                     "dtype": str(np.asarray(a).dtype)}
                    for a in example_inputs],
+        "outputs": [{"shape": list(o.shape), "dtype": str(o.dtype)}
+                    for o in out_leaves],
     }
+
+    frozen_files = ("__model__frozen__.stablehlo", "compile_options.pb")
+    if freeze_native:
+        frozen = jax_export.export(
+            jax.jit(lambda *inputs: fwd(params, *inputs)),
+            platforms=platforms)(*example_inputs)
+        with open(os.path.join(path, frozen_files[0]), "wb") as f:
+            f.write(frozen.mlir_module_serialized)
+        from jaxlib import xla_client
+        with open(os.path.join(path, frozen_files[1]), "wb") as f:
+            f.write(xla_client.CompileOptions().SerializeAsString())
+    else:
+        # never leave a PREVIOUS export's frozen artifacts behind — the
+        # native runner would silently serve the old weights
+        for fname in frozen_files:
+            fpath = os.path.join(path, fname)
+            if os.path.exists(fpath):
+                os.remove(fpath)
+
     with open(os.path.join(path, _META_FILE), "w") as f:
         json.dump(meta, f, indent=2)
 
