@@ -109,6 +109,75 @@ def acquire_device():
         raise RuntimeError(f"no backend at all: {last_err} / {e}") from e
 
 
+def run_bench_resnet(dev):
+    """ResNet-50 training throughput (BASELINE config[1]): images/s/chip
+    + MFU. FLOPs per step come from XLA's own cost analysis of the
+    compiled train step (conv-appropriate by construction: every conv's
+    2*H*W*Cin*Cout*k^2 MACs are counted by the compiler, fwd+bwd+opt),
+    with the published 3 x 4.09 GFLOP/img estimate as fallback."""
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.models.resnet import ResNet50
+    from paddle_tpu.train import build_train_step, make_train_state
+
+    on_tpu = dev.platform == "tpu"
+    batch_size = 128 if on_tpu else 2  # swept: 128 ~= 256 > 64 on v5e
+    hw = 224 if on_tpu else 32
+    steps = 20 if on_tpu else 2
+    num_classes = 1000 if on_tpu else 10
+
+    model = ResNet50(num_classes=num_classes)
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9)
+    state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+    def loss_fn(params, **batch):
+        return model.loss(params, training=True, **batch)
+
+    policy = dtypes.get_policy("bf16") if on_tpu else None
+    step = jax.jit(build_train_step(loss_fn, optimizer, policy=policy),
+                   donate_argnums=(0,))
+
+    key = jax.random.PRNGKey(1)
+    batch = dict(
+        image=jax.random.normal(key, (batch_size, hw, hw, 3), jnp.float32),
+        label=jax.random.randint(key, (batch_size,), 0, num_classes,
+                                 jnp.int32),
+    )
+
+    try:  # XLA's flop count for the whole compiled step
+        cost = step.lower(state, **batch).compile().cost_analysis()
+        flops_per_step = float(cost["flops"])
+    except Exception:
+        flops_per_step = 3 * 4.09e9 * batch_size  # fwd+bwd approx
+
+    # two warmup steps: step 0 compiles; a state-signature change on
+    # step 1 (e.g. a dtype drift bug) would otherwise put a silent
+    # recompile inside the timed window
+    for _ in range(2):
+        state, metrics = step(state, **batch)
+        float(metrics["loss"])  # sync (see run_bench note)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, **batch)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * steps / dt
+    mfu = flops_per_step * steps / dt / device_peak_flops(dev)
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "batch_size": batch_size,
+        "image_size": hw,
+        "flops_per_step": flops_per_step,
+        "loss": round(final_loss, 4),
+    }
+
+
 def run_bench(dev):
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import dtypes
@@ -186,17 +255,29 @@ def run_bench(dev):
 
 
 def main():
+    # --model bert (default, the driver's headline metric) | resnet50.
+    # Either way EXACTLY ONE JSON line goes to stdout (even on bad args).
+    which = "bert"
     try:
+        if "--model" in sys.argv:
+            which = sys.argv[sys.argv.index("--model") + 1]
+        if which not in ("bert", "resnet50"):
+            raise ValueError(f"unknown --model {which!r} "
+                             "(expected bert|resnet50)")
         dev, degraded = acquire_device()
-        result = run_bench(dev)
+        result = (run_bench_resnet(dev) if which == "resnet50"
+                  else run_bench(dev))
         if degraded:
             result["error"] = degraded
             result["vs_baseline"] = 0.0
     except Exception as e:  # fail-soft: always emit a parseable line, rc=0
         result = {
-            "metric": "bert_base_tokens_per_sec_per_chip",
+            "metric": ("resnet50_images_per_sec_per_chip"
+                       if which == "resnet50"
+                       else "bert_base_tokens_per_sec_per_chip"),
             "value": 0.0,
-            "unit": "tokens/s/chip",
+            "unit": ("images/s/chip" if which == "resnet50"
+                     else "tokens/s/chip"),
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
         }

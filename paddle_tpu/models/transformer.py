@@ -160,6 +160,83 @@ class Transformer(Layer):
         tok_acc = ((logits.argmax(-1) == tgt_out) * mask).sum() / denom
         return loss, {"token_acc": tok_acc}
 
+    # -- packed variable-length training (data/packing.py) ----------------
+    #
+    # Fluid trains ragged WMT batches on LoD tensors; the TPU-native path
+    # packs many pairs into fixed (rows, S) slabs: segment ids gate
+    # attention (within-segment only; row-causality x same-segment =
+    # per-sequence causality since segments are contiguous), per-segment
+    # positions drive the sinusoid embedding, and shapes come from a
+    # bucket ladder so jit compiles O(#buckets) programs.
+
+    def _embed_packed(self, params, ids, pos, key=None, training=False):
+        cfg = self.cfg
+        x = self.embed(params["embed"], ids) * math.sqrt(cfg.d_model)
+        # per-segment positions are < the row length, so size the table by
+        # the packed bucket too (jnp.take would silently CLAMP positions
+        # past a too-small table)
+        table = sinusoid_positions(max(cfg.max_len, ids.shape[1]),
+                                   cfg.d_model)
+        x = x + jnp.take(table, pos, axis=0)
+        return self.drop(None, x, key=key, training=training)
+
+    def encode_packed(self, params, src, src_seg, src_pos, *, key=None,
+                      training=False):
+        from paddle_tpu.ops import sequence as seq_ops
+
+        cfg = self.cfg
+        bias = seq_ops.make_segment_attention_bias(src_seg)
+        keys = ([None] * (cfg.num_encoder_layers + 1) if key is None
+                else list(jax.random.split(key, cfg.num_encoder_layers + 1)))
+        x = self._embed_packed(params, src, src_pos, keys[0], training)
+        for i, layer in enumerate(self.encoder):
+            x = layer(params["encoder"][str(i)], x, bias=bias,
+                      key=keys[i + 1], training=training)
+        if cfg.pre_ln:
+            x = self.enc_ln(params["enc_ln"], x)
+        return x
+
+    def loss_packed(self, params, src, src_seg, src_pos, tgt_in, tgt_out,
+                    tgt_seg, tgt_pos, *, key=None, training=True):
+        """Packed teacher-forced loss; token-SUM and count are also
+        returned so callers can aggregate exactly across batches."""
+        from paddle_tpu.ops import sequence as seq_ops
+
+        cfg = self.cfg
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        memory = self.encode_packed(params, src, src_seg, src_pos, key=k1,
+                                    training=training)
+        # decoder self: same segment (the layer's causal=True supplies
+        # row-causality); cross: target segment matches source segment,
+        # padding (seg 0) queries see nothing real
+        self_bias = seq_ops.make_segment_attention_bias(tgt_seg)
+        cross_bias = seq_ops.make_segment_attention_bias(tgt_seg, src_seg)
+
+        keys = ([None] * (cfg.num_decoder_layers + 1) if k2 is None
+                else list(jax.random.split(k2, cfg.num_decoder_layers + 1)))
+        x = self._embed_packed(params, tgt_in, tgt_pos, keys[0], training)
+        for i, layer in enumerate(self.decoder):
+            x = layer(params["decoder"][str(i)], x, memory,
+                      self_bias=self_bias, cross_bias=cross_bias,
+                      key=keys[i + 1], training=training)
+        if cfg.pre_ln:
+            x = self.dec_ln(params["dec_ln"], x)
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt_out[..., None], axis=-1)[..., 0]
+        if cfg.label_smoothing > 0:
+            eps = cfg.label_smoothing
+            nll = (1 - eps) * nll + eps * (-logp.mean(axis=-1))
+        mask = (tgt_seg > 0).astype(jnp.float32)
+        tok_sum = (nll * mask).sum()
+        tok_count = mask.sum()
+        loss = tok_sum / jnp.maximum(tok_count, 1.0)
+        return loss, {"token_sum": tok_sum, "token_count": tok_count}
+
     def greedy_decode(self, params, src_ids, max_len=None):
         """Greedy generation (≙ reference beam_search with beam=1; full
         beam search is an inference-path follow-up). Re-runs the decoder

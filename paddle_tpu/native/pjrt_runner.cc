@@ -100,6 +100,7 @@ PJRT_Buffer_Type to_pjrt_type(int code) {
 extern "C" {
 
 void pjr_destroy(void* h);
+void pjr_exec_destroy(void* h, void* hexec);
 
 // Loads a PJRT plugin and creates a client. Plugin-specific create
 // options arrive as parallel arrays (kinds[i]: 0 = string -> str_vals[i],
@@ -246,7 +247,7 @@ void* pjr_compile(void* h, const char* code, int64_t code_size,
   g.loaded_executable = ex->loaded;
   if (check(r->api, r->api->PJRT_LoadedExecutable_GetExecutable(&g),
             "GetExecutable", err, errlen)) {
-    delete ex;
+    pjr_exec_destroy(h, ex);  // release the compiled executable too
     return nullptr;
   }
   PJRT_Executable_NumOutputs_Args n;
@@ -261,7 +262,7 @@ void* pjr_compile(void* h, const char* code, int64_t code_size,
   xd.executable = g.executable;
   r->api->PJRT_Executable_Destroy(&xd);
   if (failed) {
-    delete ex;
+    pjr_exec_destroy(h, ex);
     return nullptr;
   }
   ex->num_outputs = static_cast<int>(n.num_outputs);

@@ -235,7 +235,15 @@ def report_state(layer: Layer, updates: Dict[str, Any]):
 
 def apply_state_updates(params, cap):
     """Merge tape updates back into the parameter tree (pure).
-    Accepts a StateCapture or its raw ``{path: value}`` dict."""
+    Accepts a StateCapture or its raw ``{path: value}`` dict.
+
+    Updates are cast to the dtype of the slot they replace: under an AMP
+    policy the forward computes running stats in the compute dtype
+    (bf16), but writing bf16 into an f32 state slot would flip the state
+    pytree's dtype after the first step — degrading the stats and, worse,
+    changing the jitted step's input signature (a full recompile on step
+    two, ~40s for ResNet-50).
+    """
     if isinstance(cap, dict):
         updates = cap
         cap = StateCapture()
@@ -243,11 +251,20 @@ def apply_state_updates(params, cap):
     if not cap.updates:
         return params
 
+    def get_path(tree, path):
+        for p in path:
+            tree = tree[p]
+        return tree
+
     def set_path(tree, path, value):
         if len(path) == 1:
             return {**tree, path[0]: value}
         return {**tree, path[0]: set_path(tree[path[0]], path[1:], value)}
 
     for path, val in cap.updates.items():
+        old = get_path(params, path)
+        if hasattr(old, "dtype") and hasattr(val, "astype") \
+                and val.dtype != old.dtype:
+            val = val.astype(old.dtype)
         params = set_path(params, path, val)
     return params

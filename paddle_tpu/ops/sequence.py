@@ -122,9 +122,15 @@ def segment_max(data, segment_ids, num_segments):
     return jax.ops.segment_max(data, segment_ids, num_segments)
 
 
-def make_segment_attention_bias(segment_ids, dtype=jnp.float32):
-    """Packed sequences: (B, T) segment ids -> additive bias blocking
-    cross-segment attention (the packed-batch story for Transformer-big
-    variable-length training; ≙ LoD isolation between sequences)."""
-    same = segment_ids[:, None, :] == segment_ids[:, :, None]  # (B,T,T)
+def make_segment_attention_bias(segment_ids, kv_segment_ids=None,
+                                dtype=jnp.float32):
+    """Packed sequences: (B, Tq) segment ids -> additive (B,1,Tq,Tkv)
+    bias blocking cross-segment attention (the packed-batch story for
+    Transformer-big variable-length training; ≙ LoD isolation between
+    sequences). Pass ``kv_segment_ids`` for cross-attention between two
+    packed streams (decoder queries vs encoder keys: a pair shares its
+    segment number across streams)."""
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    same = segment_ids[:, :, None] == kv_segment_ids[:, None, :]
     return jnp.where(same, 0.0, -1e30).astype(dtype)[:, None, :, :]

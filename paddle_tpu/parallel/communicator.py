@@ -1,0 +1,193 @@
+"""Async-update communicators: the reference's non-BSP training modes.
+
+Reference mapping (``operators/distributed/communicator.h``):
+- ``AsyncCommunicator`` (:276): trainers enqueue per-var gradients; a
+  background thread merges up to ``max_merge_var_num`` pending grads and
+  sends them to the pserver, which applies them to the global params;
+  trainers keep computing on (stale) pulled params.
+- ``GeoSgdCommunicator`` (:323, ``transpiler/geo_sgd_transpiler.py``):
+  trainers run LOCAL SGD; every ``geo_need_push_nums`` steps each sends the
+  DELTA of its params since the last sync (scaled by 1/trainers) and pulls
+  the merged globals.
+
+TPU-native redesign:
+- :class:`AsyncCommunicator`: the "pserver" is a host-resident master copy
+  of the dense params; device steps produce grads, a host thread merges and
+  applies them with the optimizer while the device keeps stepping on stale
+  params — update application is off the device critical path (sparse
+  tables get the same mode from HostKVStore's async push).
+- GeoSGD has two forms: :func:`geo_sgd_sync`, a pure-functional delta-merge
+  over a mesh axis (shard_map + psum — workers are dp shards, the "server"
+  is the collective), and :class:`GeoSgdCommunicator`, the host-side
+  variant for stacked local replicas (K, ...) leaves.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AsyncCommunicator:
+    """Background gradient-merge/apply loop over a host master copy.
+
+    ``push(grads)`` never blocks on the optimizer; the worker thread
+    drains the queue, merges up to ``max_merge`` pending gradient pytrees
+    (the send-queue merge of communicator.h:166), and applies ONE
+    optimizer update for the merged batch. ``pull()`` snapshots the
+    current master params (what a trainer would fetch from the pserver).
+    """
+
+    def __init__(self, optimizer, params, *, max_merge: int = 20,
+                 queue_size: int = 64):
+        self.optimizer = optimizer
+        self._lock = threading.Lock()
+        self._params = params
+        self._opt_state = optimizer.init(params)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._cv = threading.Condition()
+        self._pending = 0
+        self.max_merge = max_merge
+        self.merged_updates = 0    # optimizer applications
+        self.pushed = 0            # grads received
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # -- trainer side ------------------------------------------------------
+    def push(self, grads):
+        """Enqueue one step's gradients (host copies; non-blocking unless
+        the queue is full — backpressure like a bounded send queue)."""
+        self._raise_if_failed()
+        grads = jax.tree_util.tree_map(jax.device_get, grads)
+        with self._cv:
+            self._pending += 1
+        self._q.put(grads)
+
+    def pull(self):
+        with self._lock:
+            return self._params
+
+    # -- server side ---------------------------------------------------------
+    def _worker(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                merged = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            count = 1
+            try:
+                while count < self.max_merge:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    merged = jax.tree_util.tree_map(jnp.add, merged, nxt)
+                    count += 1
+                mean = jax.tree_util.tree_map(lambda g: g / count, merged)
+                with self._lock:
+                    self._params, self._opt_state = self.optimizer.update(
+                        mean, self._opt_state, self._params)
+                    self.merged_updates += 1
+                    self.pushed += count
+            except Exception as e:
+                # surface at the next flush()/push() instead of silently
+                # killing the thread and deadlocking waiters
+                self._error = e
+            with self._cv:
+                self._pending -= count
+                self._cv.notify_all()
+
+    _error: Optional[Exception] = None
+
+    def _raise_if_failed(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("AsyncCommunicator worker failed") from err
+
+    def flush(self):
+        """Wait until every pushed gradient has been applied."""
+        with self._cv:
+            self._cv.wait_for(lambda: self._pending == 0)
+        self._raise_if_failed()
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join()
+
+
+def geo_sgd_sync(params, anchor, *, axis="dp", mesh=None):
+    """One GeoSGD sync point, SPMD form: every worker (= shard of ``axis``)
+    contributes its delta since ``anchor``; the merged params become the
+    new anchor everywhere.
+
+        merged = anchor + psum(params - anchor) / n
+
+    Call it under jit every ``sync_every`` steps (or via lax.cond on the
+    step counter); between syncs the per-worker params must NOT be
+    all-reduced — train them with a local (non-psum) step.
+    Returns (new_params, new_anchor), identical on every worker.
+    """
+    from paddle_tpu.core import mesh as mesh_lib
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("geo_sgd_sync requires a mesh")
+
+    def body(params, anchor):
+        n = jax.lax.axis_size(axis)
+
+        def merge(p, a):
+            return a + jax.lax.psum(p - a, axis) / n
+
+        merged = jax.tree_util.tree_map(merge, params, anchor)
+        return merged, merged
+
+    spec = jax.tree_util.tree_map(lambda _: P(), params)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec),
+        check_vma=False,
+    )(params, anchor)
+
+
+class GeoSgdCommunicator:
+    """Host-side GeoSGD over K stacked local replicas.
+
+    Replica params live as stacked (K, ...) leaves (train them with
+    ``jax.vmap`` over independent data shards). ``maybe_sync`` merges
+    deltas every ``sync_every`` steps:
+
+        anchor' = anchor + sum_k(params_k - anchor) / K
+        params_k' = anchor'
+    """
+
+    def __init__(self, sync_every: int):
+        if sync_every < 1:
+            raise ValueError("sync_every must be >= 1")
+        self.sync_every = sync_every
+
+    def init_anchor(self, stacked_params):
+        """Anchor = replica 0 (replicas must start identical)."""
+        return jax.tree_util.tree_map(lambda x: x[0], stacked_params)
+
+    def sync(self, stacked_params, anchor):
+        new_anchor = jax.tree_util.tree_map(
+            lambda p, a: a + (p - a).sum(axis=0) / p.shape[0],
+            stacked_params, anchor)
+        k = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        new_stacked = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (k,) + a.shape),
+            new_anchor)
+        return new_stacked, new_anchor
+
+    def maybe_sync(self, stacked_params, anchor, step: int):
+        """Host-loop form: sync when ``step`` hits the cadence."""
+        if (step + 1) % self.sync_every == 0:
+            return self.sync(stacked_params, anchor)
+        return stacked_params, anchor

@@ -39,6 +39,12 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_matmul_precision", "highest")
+    # exactly ONE local device per worker — the multi-process topology is
+    # the point here (a parent pytest env may set a virtual device count)
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except RuntimeError:
+        pass
 
     from paddle_tpu import fleet
     from paddle_tpu import optimizer as opt

@@ -38,6 +38,12 @@ def _env():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     extra = env.get("PYTHONPATH", "")
     env["PYTHONPATH"] = repo + (os.pathsep + extra if extra else "")
+    # a virtual-device-count flag from the parent suite would give every
+    # worker 8 local devices and break the 2-process topology
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env["XLA_FLAGS"].split()
+            if "xla_force_host_platform_device_count" not in f)
     return env
 
 
