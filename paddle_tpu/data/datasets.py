@@ -1,12 +1,147 @@
 """Stock datasets (parity: ``python/paddle/dataset/`` — mnist, cifar, imdb,
-wmt14/16…). This environment has zero network egress, so these are
-*synthetic but learnable* generators with the same sample schemas as the
-reference loaders: models and tests exercise identical shapes/dtypes.
+wmt14/16…).
+
+Two tiers:
+- REAL-FORMAT loaders (:func:`mnist`, :func:`cifar10`, :func:`imdb`) parse
+  the standard on-disk formats (idx-ubyte, cifar-10-batches-py pickles,
+  pos/neg text trees) from a local ``data_dir`` — the reference loaders'
+  parse paths without their download step (zero network egress here; point
+  ``data_dir`` at a pre-fetched copy).
+- *synthetic but learnable* generators with the same sample schemas, for
+  tests and this sandbox.
+
+All loaders are reader-creators (``paddle.dataset`` convention): calling
+them returns a ``reader()`` generator factory composable with
+``paddle_tpu.data.reader`` combinators.
 """
 
 from __future__ import annotations
 
+import gzip
+import os
+import pickle
+import struct
+
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# real-format loaders (python/paddle/dataset/{mnist,cifar,imdb}.py parse
+# paths, minus the downloader)
+# ---------------------------------------------------------------------------
+
+def _open_maybe_gz(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _find(data_dir, names):
+    for n in names:
+        for cand in (n, n + ".gz"):
+            p = os.path.join(data_dir, cand)
+            if os.path.exists(p):
+                return p
+    raise FileNotFoundError(
+        f"none of {names} (optionally .gz) under {data_dir!r} — this "
+        "environment cannot download; place the files there or use the "
+        "synthetic_* loaders")
+
+
+def mnist(data_dir, split="train"):
+    """idx-ubyte MNIST reader (paddle.dataset.mnist.train/test parity):
+    yields (image (784,) float32 in [-1, 1], label int64)."""
+    prefix = "train" if split == "train" else "t10k"
+    img_path = _find(data_dir, [f"{prefix}-images-idx3-ubyte",
+                                f"{prefix}-images.idx3-ubyte"])
+    lbl_path = _find(data_dir, [f"{prefix}-labels-idx1-ubyte",
+                                f"{prefix}-labels.idx1-ubyte"])
+
+    def reader():
+        with _open_maybe_gz(img_path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic} in {img_path}")
+            images = np.frombuffer(f.read(n * rows * cols),
+                                   np.uint8).reshape(n, rows * cols)
+        with _open_maybe_gz(lbl_path) as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic} in {lbl_path}")
+            labels = np.frombuffer(f.read(n2), np.uint8)
+        if n != n2:
+            raise ValueError(f"image/label count mismatch {n} vs {n2}")
+        for img, lbl in zip(images, labels):
+            # reference normalization: [0,255] -> [-1, 1]
+            yield (img.astype(np.float32) / 255.0 * 2.0 - 1.0,
+                   np.int64(lbl))
+
+    return reader
+
+
+def cifar10(data_dir, split="train"):
+    """cifar-10-batches-py reader (paddle.dataset.cifar.train10 parity):
+    yields (image (3072,) float32 in [0, 1], label int64)."""
+    base = data_dir
+    inner = os.path.join(data_dir, "cifar-10-batches-py")
+    if os.path.isdir(inner):
+        base = inner
+    names = ([f"data_batch_{i}" for i in range(1, 6)]
+             if split == "train" else ["test_batch"])
+
+    def reader():
+        for name in names:
+            p = os.path.join(base, name)
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} missing — zero-egress environment; stage the "
+                    "extracted cifar-10-batches-py directory locally")
+            with open(p, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            data = batch[b"data"]
+            labels = batch.get(b"labels", batch.get(b"fine_labels"))
+            for row, lbl in zip(data, labels):
+                yield (np.asarray(row, np.float32) / 255.0,
+                       np.int64(lbl))
+
+    return reader
+
+
+def imdb_build_dict(data_dir, cutoff=1):
+    """Frequency-sorted word dict over train pos/neg text files
+    (paddle.dataset.imdb.word_dict parity; <unk> gets the last id)."""
+    freq = {}
+    for sub in ("train/pos", "train/neg"):
+        d = os.path.join(data_dir, sub)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(
+                f"{d} missing — stage an extracted aclImdb tree")
+        for name in sorted(os.listdir(d)):
+            with open(os.path.join(d, name), errors="ignore") as f:
+                for w in f.read().lower().split():
+                    freq[w] = freq.get(w, 0) + 1
+    words = sorted((w for w, c in freq.items() if c > cutoff),
+                   key=lambda w: (-freq[w], w))
+    word_idx = {w: i for i, w in enumerate(words)}
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def imdb(data_dir, word_idx, split="train"):
+    """IMDB sentiment reader (paddle.dataset.imdb.train parity): yields
+    (word ids (L,) int64, label int64) with pos=1/neg=0."""
+    unk = word_idx["<unk>"]
+
+    def reader():
+        for label, sub in ((1, f"{split}/pos"), (0, f"{split}/neg")):
+            d = os.path.join(data_dir, sub)
+            for name in sorted(os.listdir(d)):
+                with open(os.path.join(d, name), errors="ignore") as f:
+                    ids = [word_idx.get(w, unk)
+                           for w in f.read().lower().split()]
+                yield np.asarray(ids, np.int64), np.int64(label)
+
+    return reader
 
 
 def synthetic_mnist(n=1024, seed=0, template_seed=0):

@@ -174,6 +174,72 @@ def multiclass_nms(boxes, scores, *, iou_threshold=0.45,
     return cls_ids, idxs.reshape(-1), valid.reshape(-1)
 
 
+@register_op("anchor_generator")
+def anchor_generator(feature_h, feature_w, *, anchor_sizes=(64, 128, 256),
+                     aspect_ratios=(0.5, 1.0, 2.0), stride=(16.0, 16.0),
+                     offset=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    """RPN anchors for one feature map (anchor_generator_op). Unlike
+    prior_box (SSD, normalized coords), returns PIXEL-coordinate xyxy
+    anchors (H*W*A, 4) plus the broadcast variances (H*W*A, 4)."""
+    sh, sw = stride
+    cy = (jnp.arange(feature_h, dtype=jnp.float32) + offset) * sh
+    cx = (jnp.arange(feature_w, dtype=jnp.float32) + offset) * sw
+    cx, cy = jnp.meshgrid(cx, cy)                             # (H, W)
+
+    whs = []
+    for size in anchor_sizes:
+        area = float(size) ** 2
+        for ar in aspect_ratios:
+            w = (area / ar) ** 0.5
+            whs.append((w, w * ar))
+    whs = jnp.asarray(whs, jnp.float32)                       # (A, 2)
+
+    centers = jnp.stack([cx, cy], -1).reshape(-1, 1, 2)       # (HW, 1, 2)
+    half = whs[None, :, :] / 2.0
+    anchors = jnp.concatenate([centers - half, centers + half],
+                              -1).reshape(-1, 4)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (anchors.shape[0], 4))
+    return anchors, var
+
+
+@register_op("roi_pool")
+def roi_pool(features, rois, *, output_size=(7, 7), spatial_scale=1.0):
+    """ROI max pooling (roi_pool_op — the quantized Fast-RCNN pooling;
+    roi_align below is the interpolated successor). features (H, W, C);
+    rois (R, 4) xyxy image coords. Returns (R, oh, ow, C)."""
+    h, w, c = features.shape
+    oh, ow = output_size
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    neg = jnp.finfo(features.dtype).min
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+        def one_bin(by, bx):
+            # quantized bin bounds (floor/ceil like the reference)
+            y_lo = y1 + jnp.floor(by * rh / oh)
+            y_hi = y1 + jnp.ceil((by + 1) * rh / oh)
+            x_lo = x1 + jnp.floor(bx * rw / ow)
+            x_hi = x1 + jnp.ceil((bx + 1) * rw / ow)
+            in_y = (ys >= y_lo) & (ys < y_hi)
+            in_x = (xs >= x_lo) & (xs < x_hi)
+            m = in_y[:, None] & in_x[None, :]
+            masked = jnp.where(m[..., None], features, neg)
+            out = masked.max(axis=(0, 1))
+            return jnp.where(m.any(), out, 0.0)               # empty bin -> 0
+
+        by = jnp.arange(oh)
+        bx = jnp.arange(ow)
+        return jax.vmap(lambda y: jax.vmap(
+            lambda x: one_bin(y, x))(bx))(by)                 # (oh, ow, C)
+
+    return jax.vmap(one_roi)(rois)
+
+
 @register_op("roi_align")
 def roi_align(features, rois, *, output_size=(7, 7), spatial_scale=1.0,
               sampling_ratio=2):

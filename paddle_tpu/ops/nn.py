@@ -362,6 +362,47 @@ def interpolate(x, size, method="nearest", data_format="NHWC"):
     return _from_nhwc(out, data_format)
 
 
-@register_op("grid_sampler", has_grad=False)
-def grid_sampler(x, grid):
-    raise NotImplementedError("grid_sampler pending (detection family)")
+@register_op("grid_sampler", has_grad=True)
+def grid_sampler(x, grid, data_format="NCHW"):
+    """Bilinear grid sampling (fluid grid_sampler_op, used by STN-style
+    detection heads). x: (N, C, H, W) NCHW (fluid layout; NHWC accepted
+    via data_format); grid: (N, Ho, Wo, 2) normalized (x, y) in [-1, 1],
+    align_corners=True mapping (-1 -> 0, 1 -> size-1), zero padding for
+    samples outside the image — fluid 1.5 semantics. Fully differentiable
+    w.r.t. both x and grid (gathers + lerps)."""
+    nchw = data_format == "NCHW"
+    if nchw:
+        x = jnp.transpose(x, (0, 2, 3, 1))  # -> NHWC
+    n, h, w, c = x.shape
+
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)   # (N, Ho, Wo)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yi, xi):
+        """img (H,W,C); yi/xi int grids; zero outside bounds."""
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        ys = jnp.clip(yi, 0, h - 1)
+        xs = jnp.clip(xi, 0, w - 1)
+        vals = img[ys, xs]                       # (Ho, Wo, C)
+        return jnp.where(inb[..., None], vals, 0.0)
+
+    def sample_one(img, x0, y0, wx, wy):
+        xi0 = x0.astype(jnp.int32)
+        yi0 = y0.astype(jnp.int32)
+        v00 = gather(img, yi0, xi0)
+        v01 = gather(img, yi0, xi0 + 1)
+        v10 = gather(img, yi0 + 1, xi0)
+        v11 = gather(img, yi0 + 1, xi0 + 1)
+        wxe = wx[..., None]
+        wye = wy[..., None]
+        return (v00 * (1 - wye) * (1 - wxe) + v01 * (1 - wye) * wxe
+                + v10 * wye * (1 - wxe) + v11 * wye * wxe)
+
+    out = jax.vmap(sample_one)(x, x0, y0, wx, wy)  # (N, Ho, Wo, C)
+    if nchw:
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out

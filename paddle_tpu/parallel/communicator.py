@@ -30,6 +30,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class AsyncCommunicator:
@@ -76,18 +77,21 @@ class AsyncCommunicator:
     def _worker(self):
         while not self._stop.is_set() or not self._q.empty():
             try:
-                merged = self._q.get(timeout=0.05)
+                items = [self._q.get(timeout=0.05)]
             except queue.Empty:
                 continue
-            count = 1
+            # dequeue the whole merge batch FIRST: count is then known
+            # before any compute can raise, so _pending stays accurate
+            while len(items) < self.max_merge:
+                try:
+                    items.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            count = len(items)
             try:
-                while count < self.max_merge:
-                    try:
-                        nxt = self._q.get_nowait()
-                    except queue.Empty:
-                        break
+                merged = items[0]
+                for nxt in items[1:]:
                     merged = jax.tree_util.tree_map(jnp.add, merged, nxt)
-                    count += 1
                 mean = jax.tree_util.tree_map(lambda g: g / count, merged)
                 with self._lock:
                     self._params, self._opt_state = self.optimizer.update(
@@ -121,73 +125,108 @@ class AsyncCommunicator:
         self._thread.join()
 
 
-def geo_sgd_sync(params, anchor, *, axis="dp", mesh=None):
-    """One GeoSGD sync point, SPMD form: every worker (= shard of ``axis``)
-    contributes its delta since ``anchor``; the merged params become the
-    new anchor everywhere.
+def geo_sgd_sync(stacked_params, anchor, *, participants=None, axis="dp",
+                 mesh=None):
+    """One GeoSGD sync point, SPMD form. Worker k's locally-trained params
+    are row k of the stacked (n, ...) leaves, SHARDED over ``axis`` (each
+    device holds exactly its own row — the genuinely divergent state);
+    ``anchor`` is replicated. ``participants`` is an (n,) bool mask of
+    workers pushing THIS round (the reference's per-trainer
+    ``geo_need_push_nums`` cadence — trainers reach their push threshold
+    at different times). The delta merge
 
-        merged = anchor + psum(params - anchor) / n
+        anchor' = anchor + psum(m_k * (local_k - anchor)) / n
+        local_k' = anchor' if m_k else local_k
 
-    Call it under jit every ``sync_every`` steps (or via lax.cond on the
-    step counter); between syncs the per-worker params must NOT be
-    all-reduced — train them with a local (non-psum) step.
-    Returns (new_params, new_anchor), identical on every worker.
+    With everyone participating this reduces to replica averaging (use
+    plain LocalSGD, optimizer/compression.py, if that is all you need);
+    the anchor is load-bearing precisely when participation is partial.
+    Returns (new_stacked, new_anchor).
     """
-    from paddle_tpu.core import mesh as mesh_lib
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.core import mesh as mesh_lib
+    from paddle_tpu.parallel import collective
 
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None:
         raise ValueError("geo_sgd_sync requires a mesh")
+    n_workers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if participants is None:
+        participants = jnp.ones((n_workers,), bool)
 
-    def body(params, anchor):
+    def body(stacked, anchor, mask):
         n = jax.lax.axis_size(axis)
+        m = mask[0].astype(jnp.float32)       # this worker's flag
 
         def merge(p, a):
-            return a + jax.lax.psum(p - a, axis) / n
+            return a + jax.lax.psum(m * (p[0] - a), axis) / n
 
-        merged = jax.tree_util.tree_map(merge, params, anchor)
-        return merged, merged
+        new_anchor = jax.tree_util.tree_map(merge, stacked, anchor)
+        new_stacked = jax.tree_util.tree_map(
+            lambda p, a: jnp.where(m > 0, a[None], p),
+            stacked, new_anchor)
+        return new_stacked, new_anchor
 
-    spec = jax.tree_util.tree_map(lambda _: P(), params)
-    return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec),
-        check_vma=False,
-    )(params, anchor)
+    stacked_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    repl_spec = jax.tree_util.tree_map(lambda _: P(), anchor)
+    return collective.shard_map(
+        body, mesh=mesh, in_specs=(stacked_spec, repl_spec, P(axis)),
+        out_specs=(stacked_spec, repl_spec),
+    )(stacked_params, anchor, participants)
 
 
 class GeoSgdCommunicator:
     """Host-side GeoSGD over K stacked local replicas.
 
     Replica params live as stacked (K, ...) leaves (train them with
-    ``jax.vmap`` over independent data shards). ``maybe_sync`` merges
-    deltas every ``sync_every`` steps:
+    ``jax.vmap`` over independent data shards). Each replica pushes on its
+    OWN cadence (``sync_every`` can be per-replica, matching the
+    reference's per-trainer ``geo_need_push_nums``); at a sync point the
+    participating replicas' deltas move the anchor and those replicas
+    reset to it while the rest keep training locally:
 
-        anchor' = anchor + sum_k(params_k - anchor) / K
-        params_k' = anchor'
+        anchor' = anchor + sum_{k in S}(params_k - anchor) / K
+        params_k' = anchor'  (k in S);  unchanged otherwise
+
+    With S = all replicas this is plain replica averaging — prefer
+    LocalSGD (optimizer/compression.py) then; the anchor earns its keep
+    under partial/asynchronous participation.
     """
 
-    def __init__(self, sync_every: int):
-        if sync_every < 1:
+    def __init__(self, sync_every):
+        every = np.atleast_1d(np.asarray(sync_every, np.int64))
+        if (every < 1).any():
             raise ValueError("sync_every must be >= 1")
-        self.sync_every = sync_every
+        self.sync_every = every
 
     def init_anchor(self, stacked_params):
         """Anchor = replica 0 (replicas must start identical)."""
         return jax.tree_util.tree_map(lambda x: x[0], stacked_params)
 
-    def sync(self, stacked_params, anchor):
-        new_anchor = jax.tree_util.tree_map(
-            lambda p, a: a + (p - a).sum(axis=0) / p.shape[0],
-            stacked_params, anchor)
+    def sync(self, stacked_params, anchor, participants=None):
         k = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        if participants is None:
+            participants = jnp.ones((k,), bool)
+        m = jnp.asarray(participants)
+
+        def bmask(a):
+            return m.reshape((k,) + (1,) * (a.ndim - 1))
+
+        new_anchor = jax.tree_util.tree_map(
+            lambda p, a: a + jnp.where(bmask(p), p - a, 0.0).sum(0) / k,
+            stacked_params, anchor)
         new_stacked = jax.tree_util.tree_map(
-            lambda a: jnp.broadcast_to(a[None], (k,) + a.shape),
-            new_anchor)
+            lambda p, a: jnp.where(bmask(p), a[None], p),
+            stacked_params, new_anchor)
         return new_stacked, new_anchor
 
     def maybe_sync(self, stacked_params, anchor, step: int):
-        """Host-loop form: sync when ``step`` hits the cadence."""
-        if (step + 1) % self.sync_every == 0:
-            return self.sync(stacked_params, anchor)
-        return stacked_params, anchor
+        """Host-loop form: replicas whose cadence divides ``step + 1``
+        participate this round."""
+        participants = (step + 1) % self.sync_every == 0
+        if not participants.any():
+            return stacked_params, anchor
+        k = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        mask = jnp.asarray(np.broadcast_to(participants, (k,)))
+        return self.sync(stacked_params, anchor, mask)

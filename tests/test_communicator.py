@@ -143,46 +143,75 @@ class TestGeoSgd:
         final = float(loss_fn(anchor, x, y))
         assert final < start * 0.2, (start, final)
 
-    def test_spmd_geo_sync_on_mesh(self):
-        """geo_sgd_sync over the dp axis: per-shard divergent params merge
-        to anchor + mean delta, replicated everywhere."""
-        mesh = make_mesh(MeshConfig(dp=8))
-        anchor = {"w": jnp.zeros((8, 4))}
-        # give each dp shard a different param value via iota on dim 0
-        params = {"w": jnp.broadcast_to(
-            jnp.arange(8.0)[:, None], (8, 4))}
-        # params is sharded over dp? geo_sgd_sync expects REPLICATED leaves
-        # per worker with in_specs P() — emulate divergence by the shard's
-        # own value: use axis_index inside a shard_map-trained step. Here
-        # we instead check the identity: identical params on all workers
-        # merge to themselves.
-        with mesh_context(mesh):
-            new_params, new_anchor = jax.jit(
-                lambda p, a: geo_sgd_sync(p, a, mesh=mesh))(params, anchor)
-        np.testing.assert_allclose(np.asarray(new_params["w"]),
-                                   np.asarray(params["w"]))
-        np.testing.assert_allclose(np.asarray(new_anchor["w"]),
-                                   np.asarray(params["w"]))
+    def test_partial_participation_anchor_matters(self):
+        """Only replicas past their cadence push; the anchor is
+        load-bearing: non-participants keep their local params and the
+        anchor moves by the participants' deltas only."""
+        comm = GeoSgdCommunicator(sync_every=1)
+        anchor = {"w": jnp.zeros((3,))}
+        stacked = {"w": jnp.stack([jnp.full((3,), 4.0),
+                                   jnp.full((3,), 8.0)])}
+        mask = jnp.asarray([True, False])
+        new_stacked, new_anchor = comm.sync(stacked, anchor, mask)
+        # anchor' = 0 + (4 - 0)/2 = 2; replica 0 resets, replica 1 stays
+        np.testing.assert_allclose(np.asarray(new_anchor["w"]), 2.0)
+        np.testing.assert_allclose(np.asarray(new_stacked["w"][0]), 2.0)
+        np.testing.assert_allclose(np.asarray(new_stacked["w"][1]), 8.0)
+
+    def test_per_replica_cadence(self):
+        """sync_every can be per-replica (geo_need_push_nums per trainer):
+        replica 0 pushes every step, replica 1 every 3rd."""
+        comm = GeoSgdCommunicator(sync_every=np.array([1, 3]))
+        anchor = {"w": jnp.zeros((1,))}
+        stacked = {"w": jnp.asarray([[3.0], [9.0]])}
+        out, a1 = comm.maybe_sync(stacked, anchor, step=0)  # only rep 0
+        np.testing.assert_allclose(np.asarray(a1["w"]), 1.5)
+        np.testing.assert_allclose(np.asarray(out["w"]), [[1.5], [9.0]])
+        out, a2 = comm.maybe_sync(out, a1, step=2)          # both push
+        # anchor'' = 1.5 + ((1.5-1.5) + (9-1.5))/2 = 5.25
+        np.testing.assert_allclose(np.asarray(a2["w"]), 5.25)
+        np.testing.assert_allclose(np.asarray(out["w"]), 5.25)
 
     def test_spmd_geo_sync_divergent_workers(self):
-        """Per-worker divergence (via axis_index) merges to the delta
-        mean: anchor 0, worker i holds i -> merged = mean(0..7) = 3.5."""
-        from jax.sharding import PartitionSpec as P
-
+        """SPMD form: stacked rows sharded over dp hold genuinely
+        divergent per-worker params; sync merges deltas to the anchor."""
         mesh = make_mesh(MeshConfig(dp=8))
-
-        def diverge_and_sync(anchor):
-            def body(a):
-                i = jax.lax.axis_index("dp").astype(jnp.float32)
-                local = a + i          # worker-local params
-                n = jax.lax.axis_size("dp")
-                merged = a + jax.lax.psum(local - a, "dp") / n
-                return merged
-
-            spec = P()
-            return jax.shard_map(body, mesh=mesh, in_specs=(spec,),
-                                 out_specs=spec, check_vma=False)(anchor)
-
+        anchor = {"w": jnp.full((4,), 1.0)}
+        stacked = {"w": jnp.arange(8.0)[:, None]
+                   * jnp.ones((1, 4)) + 1.0}   # worker i holds 1 + i
         with mesh_context(mesh):
-            out = jax.jit(diverge_and_sync)(jnp.zeros((4,)))
-        np.testing.assert_allclose(np.asarray(out), 3.5)
+            new_stacked, new_anchor = jax.jit(
+                lambda p, a: geo_sgd_sync(p, a, mesh=mesh))(stacked, anchor)
+        # anchor' = 1 + mean(i) = 4.5, every row reset to it
+        np.testing.assert_allclose(np.asarray(new_anchor["w"]), 4.5)
+        np.testing.assert_allclose(np.asarray(new_stacked["w"]),
+                                   np.full((8, 4), 4.5))
+
+    def test_spmd_geo_sync_partial(self):
+        mesh = make_mesh(MeshConfig(dp=8))
+        anchor = {"w": jnp.zeros((4,))}
+        stacked = {"w": jnp.broadcast_to(
+            jnp.arange(8.0)[:, None], (8, 4))}  # worker i holds i
+        mask = jnp.asarray([True] * 4 + [False] * 4)
+        with mesh_context(mesh):
+            new_stacked, new_anchor = jax.jit(
+                lambda p, a, m: geo_sgd_sync(p, a, participants=m,
+                                             mesh=mesh))(
+                stacked, anchor, mask)
+        # anchor' = (0+1+2+3)/8 = 0.75; workers 4..7 keep their params
+        np.testing.assert_allclose(np.asarray(new_anchor["w"]), 0.75)
+        got = np.asarray(new_stacked["w"])
+        np.testing.assert_allclose(got[:4], 0.75)
+        np.testing.assert_allclose(got[4:],
+                                   np.arange(4.0, 8.0)[:, None]
+                                   * np.ones((1, 4)))
+
+
+class TestAsyncCommunicatorErrors:
+    def test_bad_grads_surface_not_deadlock(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        comm = AsyncCommunicator(opt.SGD(learning_rate=0.1), params)
+        comm.push({"wrong": jnp.ones((2,))})   # structure mismatch
+        with pytest.raises(RuntimeError, match="worker failed"):
+            comm.flush()                        # raises, does NOT hang
