@@ -174,6 +174,110 @@ def multiclass_nms(boxes, scores, *, iou_threshold=0.45,
     return cls_ids, idxs.reshape(-1), valid.reshape(-1)
 
 
+@register_op("box_clip")
+def box_clip(boxes, im_shape):
+    """Clip xyxy boxes into the image (box_clip_op). boxes (..., 4);
+    im_shape (2,) = (h, w) or (..., 2) broadcastable."""
+    im_shape = jnp.asarray(im_shape, boxes.dtype)
+    h = im_shape[..., 0:1]
+    w = im_shape[..., 1:2]
+    x1 = jnp.clip(boxes[..., 0:1], 0.0, w - 1)
+    y1 = jnp.clip(boxes[..., 1:2], 0.0, h - 1)
+    x2 = jnp.clip(boxes[..., 2:3], 0.0, w - 1)
+    y2 = jnp.clip(boxes[..., 3:4], 0.0, h - 1)
+    return jnp.concatenate([x1, y1, x2, y2], axis=-1)
+
+
+@register_op("matrix_nms")
+def matrix_nms(boxes, scores, *, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0):
+    """Matrix NMS (matrix_nms_op, SOLOv2): fully parallel soft-NMS — each
+    box's score decays by its worst overlap with any HIGHER-scored box,
+    compensated by how suppressed that box itself is. No sequential loop:
+    one (K, K) IoU matrix + reductions, the XLA/MXU-friendly NMS.
+
+    boxes (N,4), scores (N,). Returns (indices (keep_top_k,), new_scores,
+    valid) — fixed shapes, validity-masked like :func:`nms`.
+    """
+    n = boxes.shape[0]
+    k = min(nms_top_k, n)
+    top_scores, order = jax.lax.top_k(
+        jnp.where(scores >= score_threshold, scores, -jnp.inf), k)
+    cand = boxes[order]                                    # (K, 4)
+    iou = box_iou(cand, cand)                              # (K, K)
+    # pairwise IoU with strictly higher-scored boxes only (upper triangle)
+    higher = jnp.triu(jnp.ones((k, k), bool), 1)           # j < i in score
+    iou_h = jnp.where(higher.T, iou, 0.0)                  # (i, j): j higher
+    # compensation: how suppressed the suppressor itself is
+    comp = iou_h.max(axis=1)                               # per-box
+    comp_j = comp[None, :]
+    if use_gaussian:
+        decay = jnp.exp(-(iou_h ** 2 - comp_j ** 2) / gaussian_sigma)
+    else:
+        decay = (1.0 - iou_h) / jnp.maximum(1.0 - comp_j, 1e-10)
+    decay = jnp.where(iou_h > 0.0, decay, 1.0).min(axis=1)
+    new_scores = jnp.where(jnp.isfinite(top_scores),
+                           top_scores * decay, -jnp.inf)
+    new_scores = jnp.where(new_scores >= post_threshold, new_scores,
+                           -jnp.inf)
+    kk = min(keep_top_k, k)
+    kept_scores, kept = jax.lax.top_k(new_scores, kk)
+    idxs = order[kept]
+    valid = jnp.isfinite(kept_scores)
+    pad = keep_top_k - kk
+    if pad > 0:
+        idxs = jnp.concatenate([idxs, jnp.zeros((pad,), idxs.dtype)])
+        kept_scores = jnp.concatenate(
+            [kept_scores, jnp.full((pad,), -jnp.inf)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+    return idxs, jnp.where(valid, kept_scores, 0.0), valid
+
+
+@register_op("density_prior_box")
+def density_prior_box(feature_h, feature_w, image_h, image_w, *,
+                      fixed_sizes, fixed_ratios=(1.0,), densities=(1,),
+                      step=None, offset=0.5, clip=True):
+    """Density prior boxes (density_prior_box_op, PyramidBox face
+    detection): each (fixed_size, density) pair tiles density^2 shifted
+    anchor centers per cell. Returns (H*W*A, 4) normalized xyxy with
+    A = sum(d^2) * len(fixed_ratios)."""
+    if len(fixed_sizes) != len(densities):
+        raise ValueError(
+            f"fixed_sizes ({len(fixed_sizes)}) and densities "
+            f"({len(densities)}) must pair up one-to-one")
+    step_h = step or image_h / feature_h
+    step_w = step or image_w / feature_w
+    cy0 = (jnp.arange(feature_h) + offset) * step_h
+    cx0 = (jnp.arange(feature_w) + offset) * step_w
+    cx0, cy0 = jnp.meshgrid(cx0, cy0)            # (H, W)
+
+    rows = []
+    for size, density in zip(fixed_sizes, densities):
+        # reference derives the sub-center shift from the averaged step
+        # (matters when the feature grid is anisotropic)
+        shift = (step_h + step_w) / 2.0 / density
+        for ratio in fixed_ratios:
+            w = size * (ratio ** 0.5)
+            h = size / (ratio ** 0.5)
+            for di in range(density):
+                for dj in range(density):
+                    ox = (dj + 0.5) * shift - step_w / 2.0
+                    oy = (di + 0.5) * shift - step_h / 2.0
+                    rows.append((ox, oy, w, h))
+    offs = jnp.asarray(rows, jnp.float32)        # (A, 4): ox, oy, w, h
+
+    centers = jnp.stack([cx0, cy0], -1).reshape(-1, 1, 2)   # (HW, 1, 2)
+    ctr = centers + offs[None, :, :2]
+    half = offs[None, :, 2:] / 2.0
+    boxes = jnp.concatenate([ctr - half, ctr + half], -1).reshape(-1, 4)
+    boxes = boxes / jnp.asarray([image_w, image_h, image_w, image_h],
+                                jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
 @register_op("anchor_generator")
 def anchor_generator(feature_h, feature_w, *, anchor_sizes=(64, 128, 256),
                      aspect_ratios=(0.5, 1.0, 2.0), stride=(16.0, 16.0),

@@ -152,6 +152,12 @@ def geo_sgd_sync(stacked_params, anchor, *, participants=None, axis="dp",
     if mesh is None:
         raise ValueError("geo_sgd_sync requires a mesh")
     n_workers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    axis_size = mesh.shape[axis]
+    if n_workers != axis_size:
+        raise ValueError(
+            f"stacked worker rows ({n_workers}) must equal mesh axis "
+            f"'{axis}' size ({axis_size}) — each device holds exactly its "
+            "own row")
     if participants is None:
         participants = jnp.ones((n_workers,), bool)
 

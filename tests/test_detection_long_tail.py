@@ -174,6 +174,71 @@ class TestNmsAtScale:
         np.testing.assert_array_equal(got, want)
 
 
+class TestBoxClip:
+    def test_clip(self):
+        boxes = jnp.asarray([[-5.0, -5.0, 50.0, 50.0],
+                             [10.0, 10.0, 20.0, 20.0]])
+        out = D.box_clip(boxes, (32, 40))   # h=32, w=40
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[0, 0, 39, 31], [10, 10, 20, 20]])
+
+
+class TestMatrixNms:
+    def test_duplicate_suppressed_distinct_kept(self):
+        boxes = jnp.asarray([
+            [0.0, 0.0, 10.0, 10.0],
+            [0.5, 0.5, 10.5, 10.5],    # near-duplicate of 0
+            [50.0, 50.0, 60.0, 60.0],  # far away
+        ])
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        idxs, new_scores, valid = D.matrix_nms(
+            boxes, scores, keep_top_k=3, post_threshold=0.0)
+        got = {int(i): float(s) for i, s, v in
+               zip(idxs, new_scores, valid) if v}
+        assert got[0] == pytest.approx(0.9)        # top box undecayed
+        assert got[2] == pytest.approx(0.7)        # disjoint box undecayed
+        assert got[1] < 0.25                       # duplicate crushed
+
+    def test_gaussian_kernel_and_post_threshold(self):
+        boxes = jnp.asarray([[0.0, 0.0, 10.0, 10.0],
+                             [0.0, 0.0, 10.0, 10.0]])
+        scores = jnp.asarray([0.9, 0.8])
+        _, s, valid = D.matrix_nms(boxes, scores, keep_top_k=2,
+                                   use_gaussian=True, gaussian_sigma=0.5,
+                                   post_threshold=0.5)
+        kept = np.asarray(s)[np.asarray(valid)]
+        np.testing.assert_allclose(kept, [0.9])    # identical box killed
+
+    def test_fixed_shapes_under_jit(self):
+        rng = np.random.default_rng(0)
+        boxes = jnp.asarray(rng.uniform(0, 100, (500, 4)).astype(np.float32))
+        boxes = boxes.at[:, 2:].set(boxes[:, :2] + 5.0)
+        scores = jnp.asarray(rng.uniform(size=(500,)).astype(np.float32))
+        f = jax.jit(lambda b, s: D.matrix_nms(b, s, nms_top_k=200,
+                                              keep_top_k=50))
+        idxs, new_scores, valid = f(boxes, scores)
+        assert idxs.shape == (50,) and valid.shape == (50,)
+        assert bool(valid.any())
+
+
+class TestDensityPriorBox:
+    def test_counts_and_density_tiling(self):
+        boxes = D.density_prior_box(
+            2, 2, 64, 64, fixed_sizes=(8.0, 16.0), densities=(2, 1),
+            fixed_ratios=(1.0,), clip=False)
+        # A = 2^2 + 1^2 = 5 per cell
+        assert boxes.shape == (2 * 2 * 5, 4)
+        b = np.asarray(boxes) * 64.0
+        w = b[:, 2] - b[:, 0]
+        per_cell = w.reshape(4, 5)
+        np.testing.assert_allclose(per_cell[:, :4], 8.0, rtol=1e-5)
+        np.testing.assert_allclose(per_cell[:, 4], 16.0, rtol=1e-5)
+        # density-2 sub-centers are distinct within the cell
+        cx = (b[:, 0] + b[:, 2]) / 2
+        cell0 = cx.reshape(4, 5)[0, :4]
+        assert len(np.unique(np.round(cell0, 3))) == 2
+
+
 class TestRealFormatLoaders:
     def test_mnist_idx_parsing(self, tmp_path):
         from paddle_tpu.data.datasets import mnist

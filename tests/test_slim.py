@@ -1,0 +1,171 @@
+"""slim (compression) tests: pruning + distillation.
+
+Reference analog: contrib/slim tests — prune ratios produce the requested
+sparsity, pruned retraining recovers accuracy, distillation losses match
+their definitions and train a student toward the teacher.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import slim
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer
+
+
+class _MLP(Layer):
+    def __init__(self, out=4):
+        super().__init__()
+        self.fc1 = Linear(16, 64, sharding=None)
+        self.fc2 = Linear(64, out, sharding=None)
+
+    def forward(self, params, x):
+        return self.fc2(params["fc2"], jnp.tanh(self.fc1(params["fc1"], x)))
+
+
+class TestPruning:
+    def test_mask_sparsity_and_selection(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        masks = slim.magnitude_prune_masks(params, 0.5)
+        # weights masked at ~50%; biases untouched
+        w_mask = masks["fc1"]["weight"]
+        assert abs(float(w_mask.mean()) - 0.5) < 0.02
+        np.testing.assert_array_equal(np.asarray(masks["fc1"]["bias"]), 1.0)
+        # smallest magnitudes are the ones dropped
+        w = np.abs(np.asarray(params["fc1"]["weight"]))
+        kept = w[np.asarray(w_mask) > 0]
+        dropped = w[np.asarray(w_mask) == 0]
+        assert kept.min() >= dropped.max() - 1e-7
+
+    def test_sparsity_of(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        masks = slim.magnitude_prune_masks(params, 0.7)
+        s = slim.sparsity_of(masks)
+        # global sparsity is diluted by unmasked biases
+        assert 0.5 < s < 0.7
+
+    def test_bad_sparsity_rejected(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError):
+            slim.magnitude_prune_masks(params, 1.0)
+
+    def test_pruned_training_keeps_zeros(self):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        model = _MLP(out=1)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+        y = jnp.asarray((x[:, 0] * 0.5).astype(np.float32))
+
+        optimizer = opt.Adam(learning_rate=1e-2)
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        masks = slim.magnitude_prune_masks(state["params"], 0.6)
+        state["params"] = slim.apply_masks(state["params"], masks)
+
+        def loss_fn(params, x, y):
+            return ((model(params, x)[:, 0] - y) ** 2).mean()
+
+        step = jax.jit(slim.pruned_train_step(
+            build_train_step(loss_fn, optimizer), masks))
+        losses = []
+        for _ in range(40):
+            state, m = step(state, x=x, y=y)
+            losses.append(float(m["loss"]))
+        # pruned positions stayed EXACTLY zero through Adam updates
+        w = np.asarray(state["params"]["fc1"]["weight"])
+        np.testing.assert_array_equal(
+            w[np.asarray(masks["fc1"]["weight"]) == 0], 0.0)
+        # and the pruned model still learns
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_sensitivity_ordering(self):
+        """More pruning on a layer never helps on the data the weights
+        were fit to; per-layer maps are monotone-ish in loss."""
+        model = _MLP(out=1)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(128, 16)).astype(np.float32))
+        y = jnp.asarray((x[:, 0] - x[:, 1]).astype(np.float32))
+        params = model.init(jax.random.PRNGKey(0))
+        # fit briefly so weights are meaningful
+        from paddle_tpu import optimizer as opt
+        sgd = opt.Adam(learning_rate=1e-2)
+        s = sgd.init(params)
+        g = jax.jit(jax.grad(
+            lambda p: ((model(p, x)[:, 0] - y) ** 2).mean()))
+        for _ in range(60):
+            params, s = sgd.update(g(params), s, params)
+
+        loss_fn = jax.jit(
+            lambda p: ((model(p, x)[:, 0] - y) ** 2).mean())
+        sens = slim.sensitivity_analysis(loss_fn, params,
+                                         sparsities=(0.3, 0.9))
+        assert set(sens) == {("fc1", "weight"), ("fc2", "weight")}
+        for path, table in sens.items():
+            assert table[0.9] >= table[0.0] - 1e-6, (path, table)
+
+
+class TestDistillation:
+    def test_soft_label_loss_zero_when_equal(self):
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(8, 10)))
+        assert float(slim.soft_label_loss(logits, logits,
+                                          temperature=3.0)) < 1e-6
+
+    def test_soft_label_matches_manual_kl(self):
+        rng = np.random.default_rng(1)
+        s = rng.normal(size=(4, 6)).astype(np.float32)
+        t = rng.normal(size=(4, 6)).astype(np.float32)
+        T = 2.0
+
+        def softmax(z):
+            e = np.exp(z - z.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+
+        tp = softmax(t / T)
+        sp = softmax(s / T)
+        kl = (tp * (np.log(tp) - np.log(sp))).sum(-1).mean() * T * T
+        got = float(slim.soft_label_loss(jnp.asarray(s), jnp.asarray(t),
+                                         temperature=T))
+        assert got == pytest.approx(kl, rel=1e-5)
+
+    def test_fsp_matrix_shape_and_mismatch(self):
+        a = jnp.ones((2, 4, 4, 3))
+        b = jnp.ones((2, 4, 4, 5))
+        m = slim.fsp_matrix(a, b)
+        assert m.shape == (2, 3, 5)
+        np.testing.assert_allclose(np.asarray(m), 1.0)
+        with pytest.raises(ValueError):
+            slim.fsp_matrix(a, jnp.ones((2, 2, 2, 5)))
+
+    def test_student_distills_toward_teacher(self):
+        """KD-only training (alpha=1) moves student logits toward the
+        teacher's on the training inputs."""
+        from paddle_tpu import optimizer as opt
+
+        teacher = _MLP()
+        student = _MLP()
+        tp = teacher.init(jax.random.PRNGKey(0))
+        sp = student.init(jax.random.PRNGKey(1))
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+
+        def student_loss(params, x):
+            logits = student(params, x)
+            return jnp.zeros(()), {"logits": logits}
+
+        loss = slim.distill_loss_fn(
+            student_loss, lambda x: teacher(tp, x), alpha=1.0,
+            temperature=2.0)
+        optimizer = opt.Adam(learning_rate=3e-3)
+        s = optimizer.init(sp)
+        g = jax.jit(jax.grad(lambda p, x: loss(p, x=x)[0]))
+        kd0 = float(loss(sp, x=x)[1]["kd_loss"])
+        for _ in range(60):
+            sp, s = optimizer.update(g(sp, x), s, sp)
+        kd1 = float(loss(sp, x=x)[1]["kd_loss"])
+        assert kd1 < kd0 * 0.3, (kd0, kd1)
