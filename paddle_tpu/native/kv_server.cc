@@ -220,25 +220,32 @@ struct Server {
   }
 
   void Stop() {
-    if (listen_fd < 0) return;
-    stopping.store(true);
-    ::shutdown(listen_fd, SHUT_RDWR);
-    ::close(listen_fd);
-    if (accept_thread.joinable()) accept_thread.join();
-    {
-      // unblock serve threads parked in recv() on live clients — without
-      // this, Stop() hangs until every trainer disconnects
-      std::lock_guard<std::mutex> g(conns_mu);
-      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
-    }
-    {
-      std::lock_guard<std::mutex> g(conns_mu);
-      for (auto& t : conns)
+    if (listen_fd >= 0) {
+      stopping.store(true);
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      if (accept_thread.joinable()) accept_thread.join();
+      {
+        // unblock serve threads parked in recv() on live clients —
+        // without this, Stop() hangs until every trainer disconnects
+        std::lock_guard<std::mutex> g(conns_mu);
+        for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+      }
+      // join WITHOUT holding conns_mu: exiting Serve threads take it to
+      // deregister their fd (holding it here would deadlock the join)
+      std::vector<std::thread> to_join;
+      {
+        std::lock_guard<std::mutex> g(conns_mu);
+        to_join.swap(conns);
+      }
+      for (auto& t : to_join)
         if (t.joinable()) t.join();
-      conns.clear();
-      client_fds.clear();
+      {
+        std::lock_guard<std::mutex> g(conns_mu);
+        client_fds.clear();
+      }
+      listen_fd = -1;
     }
-    listen_fd = -1;
     if (store) {
       kv_destroy(store);
       store = nullptr;

@@ -253,17 +253,18 @@ def density_prior_box(feature_h, feature_w, image_h, image_w, *,
     cx0, cy0 = jnp.meshgrid(cx0, cy0)            # (H, W)
 
     rows = []
+    step_avg = (step_h + step_w) / 2.0
     for size, density in zip(fixed_sizes, densities):
-        # reference derives the sub-center shift from the averaged step
-        # (matters when the feature grid is anisotropic)
-        shift = (step_h + step_w) / 2.0 / density
+        # reference (density_prior_box_op.h:96) derives BOTH the
+        # sub-center shift and the recentering from the averaged step
+        shift = step_avg / density
         for ratio in fixed_ratios:
             w = size * (ratio ** 0.5)
             h = size / (ratio ** 0.5)
             for di in range(density):
                 for dj in range(density):
-                    ox = (dj + 0.5) * shift - step_w / 2.0
-                    oy = (di + 0.5) * shift - step_h / 2.0
+                    ox = (dj + 0.5) * shift - step_avg / 2.0
+                    oy = (di + 0.5) * shift - step_avg / 2.0
                     rows.append((ox, oy, w, h))
     offs = jnp.asarray(rows, jnp.float32)        # (A, 4): ox, oy, w, h
 

@@ -125,9 +125,17 @@ class RemoteKVStore:
     def _call(self, op, n, payload, resp_len) -> bytes:
         conn = self._acquire()
         try:
-            return conn.request(op, n, payload, resp_len)
-        finally:
-            self._release(conn)
+            out = conn.request(op, n, payload, resp_len)
+        except Exception:
+            # a failed/half-read socket is protocol-desynced: drop it so
+            # the pool never hands it to the next call
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+        self._release(conn)
+        return out
 
     # -- HostKVStore-compatible surface -----------------------------------
     def pull(self, ids: np.ndarray, out: Optional[np.ndarray] = None
@@ -135,7 +143,9 @@ class RemoteKVStore:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         raw = self._call(OP_PULL, ids.size, ids.tobytes(),
                          ids.size * self.dim * 4)
-        vals = np.frombuffer(raw, np.float32).reshape(ids.size, self.dim)
+        # writable copy: HostKVStore.pull returns mutable rows (drop-in)
+        vals = np.frombuffer(raw, np.float32).reshape(
+            ids.size, self.dim).copy()
         if out is None:
             return vals
         out[:ids.size] = vals

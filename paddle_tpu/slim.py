@@ -155,6 +155,52 @@ def fsp_loss(student_pairs, teacher_pairs):
     return jnp.stack(losses).mean()
 
 
+# ---------------------------------------------------------------------------
+# NAS (light): simulated-annealing architecture search
+# ---------------------------------------------------------------------------
+
+def sa_search(space: Dict[str, Sequence], eval_fn: Callable[[dict], float],
+              *, iters: int = 50, init_temp: float = 1.0,
+              cooling: float = 0.95, seed: int = 0,
+              init: Optional[dict] = None):
+    """Simulated-annealing search over a discrete config space (slim
+    light_nas ``sa_controller`` analog: mutate one knob per step, accept
+    worse candidates with exp(-delta/T), anneal T).
+
+    ``space``: {knob: [choices...]}; ``eval_fn(config) -> float`` is the
+    reward to MAXIMIZE (e.g. -latency-penalized eval loss). Returns
+    (best_config, best_reward, history).
+    """
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+    keys = sorted(space)
+    cur = dict(init) if init is not None else \
+        {k: space[k][int(rng.integers(len(space[k])))] for k in keys}
+    for k in keys:
+        if cur[k] not in list(space[k]):
+            raise ValueError(f"init[{k!r}]={cur[k]!r} not in space")
+    cur_r = float(eval_fn(cur))
+    best, best_r = dict(cur), cur_r
+    temp = init_temp
+    history = [(dict(cur), cur_r)]
+    for _ in range(iters):
+        cand = dict(cur)
+        k = keys[int(rng.integers(len(keys)))]
+        choices = [c for c in space[k] if c != cand[k]]
+        if choices:
+            cand[k] = choices[int(rng.integers(len(choices)))]
+        r = float(eval_fn(cand))
+        if r >= cur_r or rng.random() < _np.exp((r - cur_r)
+                                                / max(temp, 1e-8)):
+            cur, cur_r = cand, r
+        if cur_r > best_r:
+            best, best_r = dict(cur), cur_r
+        history.append((dict(cand), r))
+        temp *= cooling
+    return best, best_r, history
+
+
 def distill_loss_fn(student_loss_fn: Callable, teacher_fn: Callable, *,
                     alpha: float = 0.5, temperature: float = 2.0
                     ) -> Callable:

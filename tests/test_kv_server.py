@@ -54,6 +54,30 @@ class TestInProcessServer:
         remote.close()
         srv.stop()
 
+    def test_stop_with_live_client_does_not_hang(self):
+        """A trainer that never disconnected must not deadlock server
+        shutdown (Stop unblocks serve threads, then joins lock-free)."""
+        import threading
+
+        srv = KVServer(2, optimizer="sgd")
+        c = RemoteKVStore("localhost", srv.port)
+        c.pull(np.array([1], np.int64))    # connection alive & idle
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (srv.stop(), done.set()))
+        t.start()
+        assert done.wait(timeout=20), "server stop hung with live client"
+        t.join()
+        c.close()
+
+    def test_pulled_rows_are_writable(self):
+        srv = KVServer(3, optimizer="sgd", init_scale=0.0)
+        c = RemoteKVStore("localhost", srv.port)
+        rows = c.pull(np.array([5, 6], np.int64))
+        rows[0, 0] = 42.0                  # HostKVStore drop-in contract
+        assert rows[0, 0] == 42.0
+        c.close()
+        srv.stop()
+
     def test_concurrent_async_clients(self):
         srv = KVServer(2, optimizer="sgd", init_scale=0.0)
         c = RemoteKVStore("localhost", srv.port, pool_size=4)
