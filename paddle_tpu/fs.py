@@ -48,7 +48,9 @@ class LocalFS:
         elif os.path.exists(path):
             os.remove(path)
 
-    def rename(self, src: str, dst: str):
+    def rename(self, src: str, dst: str, overwrite: bool = True):
+        if not overwrite and os.path.exists(dst):
+            raise IOError(f"rename target exists: {dst}")
         os.replace(src, dst)
 
     def upload(self, local: str, remote: str):
@@ -121,7 +123,12 @@ class HDFSClient:
     def delete(self, path: str):
         self._run("-rm", "-r", "-f", path)
 
-    def rename(self, src: str, dst: str):
+    def rename(self, src: str, dst: str, overwrite: bool = True):
+        # hadoop -mv refuses existing targets; match LocalFS's default
+        # overwrite semantics so checkpoint rotation behaves identically
+        # on both backends
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
         self._run("-mv", src, dst)
 
     def upload(self, local: str, remote: str):

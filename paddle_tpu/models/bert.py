@@ -132,53 +132,26 @@ class BertModel(Layer):
     def _encoder_pipelined(self, params, x, bias, layer_keys, training):
         """GPipe the encoder stack over "pp" (PipelineOptimizer analog,
         optimizer.py:2931): per-layer params are stacked to (L, ...) leaves
-        sharded over the stage axis; the attention bias and microbatch
-        index ride the ring with the activation (bias is per-microbatch;
-        the index folds into each layer's dropout key)."""
+        sharded over the stage axis; the attention bias rides the ring as
+        a per-microbatch extra."""
         from paddle_tpu.parallel import pipeline as pp_lib
 
         cfg = self.cfg
         M = cfg.pp_microbatches
-        b, s, d = x.shape
-        if b % M:
-            raise ValueError(f"batch {b} not divisible by "
-                             f"pp_microbatches={M}")
-        stacked = pp_lib.stack_layer_params(
-            [params["encoder"][str(i)] for i in range(cfg.num_layers)])
-        has_keys = layer_keys[0] is not None
-        if has_keys:
-            stacked = (stacked, jnp.stack(layer_keys))
-        x_mb = x.reshape((M, b // M, s, d))
-        extras = None
+        b = x.shape[0]
+        extras = extras_spec = None
         if bias is not None:
             extras = bias.reshape((M, b // M) + bias.shape[1:])
-
-        block_layer = self.encoder[0]  # identical structure for all layers
-
-        def block(lp, h, extra, mb_idx):
-            if has_keys:
-                layer_params, lkey = lp
-                k = jax.random.fold_in(lkey, mb_idx)
-                # decorrelate dropout masks across data-parallel shards:
-                # inside the shard_map the key is replicated, but each
-                # (dp, fsdp) shard holds different batch rows and must draw
-                # a different mask (the non-pipelined path draws over the
-                # global batch)
-                k = jax.random.fold_in(
-                    k, jax.lax.axis_index(("dp", "fsdp")))
-            else:
-                layer_params, k = lp, None
-            return block_layer(layer_params, h, bias=extra, key=k,
-                               training=training)
-
-        x_spec = P(None, ("dp", "fsdp"), None, None)
-        extras_spec = None
-        if extras is not None:
             extras_spec = P(*((None, ("dp", "fsdp"))
                               + (None,) * (extras.ndim - 2)))
-        out = pp_lib.gpipe(block, stacked, x_mb, extras=extras,
-                           x_spec=x_spec, extras_spec=extras_spec)
-        return out.reshape(b, s, d)
+
+        block_layer = self.encoder[0]  # identical structure for all layers
+        return pp_lib.gpipe_layer_stack(
+            lambda lp, h, extra, k: block_layer(
+                lp, h, bias=extra, key=k, training=training),
+            [params["encoder"][str(i)] for i in range(cfg.num_layers)],
+            x, num_microbatches=M, layer_keys=layer_keys,
+            extras=extras, extras_spec=extras_spec)
 
 
 class BertPretrainingHeads(Layer):

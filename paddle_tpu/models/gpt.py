@@ -30,6 +30,9 @@ class GPTConfig:
     max_position: int = 1024
     dropout: float = 0.0
     attn_impl: str = "auto"
+    # GPipe the block stack over the "pp" mesh axis (parallel/pipeline.py)
+    pipeline: bool = False
+    pp_microbatches: int = 2
 
     @classmethod
     def tiny(cls, **kw):
@@ -88,11 +91,29 @@ class GPT(Layer):
         x = self.wte(params["wte"], ids) + self.wpe(params["wpe"], pos)
         x = self.drop(None, x, key=keys[0], training=training)
         x = _constrain(x, ACT_SPEC)
-        for i, block in enumerate(self.blocks):
-            x = block(params["blocks"][str(i)], x, key=keys[i + 1],
-                      training=training)
+        if cfg.pipeline:
+            x = self._blocks_pipelined(params, x, keys[1:], training)
+        else:
+            for i, block in enumerate(self.blocks):
+                x = block(params["blocks"][str(i)], x, key=keys[i + 1],
+                          training=training)
         x = self.ln_f(params["ln_f"], x)
         return jnp.einsum("bsd,vd->bsv", x, params["wte"]["weight"])
+
+    def _blocks_pipelined(self, params, x, layer_keys, training):
+        """GPipe over "pp" (shared schedule wrapper; the decoder-only
+        stack has no per-microbatch bias — causality is inside the
+        block)."""
+        from paddle_tpu.parallel import pipeline as pp_lib
+
+        cfg = self.cfg
+        block0 = self.blocks[0]
+        return pp_lib.gpipe_layer_stack(
+            lambda lp, h, extra, k: block0(lp, h, key=k,
+                                           training=training),
+            [params["blocks"][str(i)] for i in range(cfg.num_layers)],
+            x, num_microbatches=cfg.pp_microbatches,
+            layer_keys=layer_keys)
 
     def loss(self, params, ids, *, key=None, training=True):
         """Next-token LM loss over ids (B, S): predict ids[:,1:]."""

@@ -106,10 +106,6 @@ struct Server {
   void Serve(int fd) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    {
-      std::lock_guard<std::mutex> g(conns_mu);
-      client_fds.push_back(fd);
-    }
     std::vector<int64_t> ids;
     std::vector<float> vals;
     for (;;) {
@@ -212,7 +208,11 @@ struct Server {
           if (stopping.load()) return;
           continue;
         }
+        // register the fd BEFORE the serve thread exists: Stop() must
+        // always see (and shutdown) every accepted connection, even one
+        // whose thread the OS has not scheduled yet
         std::lock_guard<std::mutex> g(conns_mu);
+        client_fds.push_back(fd);
         conns.emplace_back([this, fd] { Serve(fd); });
       }
     });

@@ -253,11 +253,11 @@ def density_prior_box(feature_h, feature_w, image_h, image_w, *,
     cx0, cy0 = jnp.meshgrid(cx0, cy0)            # (H, W)
 
     rows = []
-    step_avg = (step_h + step_w) / 2.0
+    # reference (density_prior_box_op.h:96) TRUNCATES the averaged step
+    # and the per-density shift to int — match exactly
+    step_avg = int((step_h + step_w) * 0.5)
     for size, density in zip(fixed_sizes, densities):
-        # reference (density_prior_box_op.h:96) derives BOTH the
-        # sub-center shift and the recentering from the averaged step
-        shift = step_avg / density
+        shift = int(step_avg / density)
         for ratio in fixed_ratios:
             w = size * (ratio ** 0.5)
             h = size / (ratio ** 0.5)

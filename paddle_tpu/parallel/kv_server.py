@@ -143,12 +143,11 @@ class RemoteKVStore:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         raw = self._call(OP_PULL, ids.size, ids.tobytes(),
                          ids.size * self.dim * 4)
-        # writable copy: HostKVStore.pull returns mutable rows (drop-in)
-        vals = np.frombuffer(raw, np.float32).reshape(
-            ids.size, self.dim).copy()
+        vals = np.frombuffer(raw, np.float32).reshape(ids.size, self.dim)
         if out is None:
-            return vals
-        out[:ids.size] = vals
+            # writable copy: HostKVStore.pull returns mutable rows
+            return vals.copy()
+        out[:ids.size] = vals   # one copy, straight into the caller buffer
         return out[:ids.size]
 
     def pull_async(self, ids: np.ndarray,

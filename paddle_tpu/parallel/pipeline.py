@@ -136,6 +136,57 @@ def gpipe(
     )(stacked_params, x_microbatches, extras)
 
 
+def gpipe_layer_stack(
+    apply_layer: Callable,
+    params_list,
+    x,
+    *,
+    num_microbatches: int,
+    layer_keys=None,
+    extras: Any = None,
+    extras_spec: Any = None,
+    x_spec: Optional[P] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """Model-facing wrapper: run a stack of identical layers through the
+    GPipe schedule. Handles param stacking, per-layer dropout-key
+    stacking with microbatch + data-shard decorrelation (every (dp,fsdp)
+    shard holds different rows and must draw different masks), batch
+    microbatching, and the reshape back.
+
+    ``apply_layer(layer_params, h, extra, key) -> h``; ``params_list`` is
+    the per-layer param dicts in order; ``x``: (B, ...) activations;
+    ``extras``: optional (M, ...) per-microbatch side inputs (microbatch
+    them before calling). Used by BERT and GPT's pipeline paths.
+    """
+    M = num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"pp_microbatches={M}")
+    stacked = stack_layer_params(list(params_list))
+    has_keys = layer_keys is not None and layer_keys[0] is not None
+    if has_keys:
+        stacked = (stacked, jnp.stack(list(layer_keys)))
+
+    def block(lp, h, extra, mb_idx):
+        if has_keys:
+            layer_params, lkey = lp
+            k = jax.random.fold_in(lkey, mb_idx)
+            k = jax.random.fold_in(
+                k, jax.lax.axis_index(("dp", "fsdp")))
+        else:
+            layer_params, k = lp, None
+        return apply_layer(layer_params, h, extra, k)
+
+    if x_spec is None:
+        x_spec = P(*((None, ("dp", "fsdp")) + (None,) * (x.ndim - 1)))
+    x_mb = x.reshape((M, b // M) + x.shape[1:])
+    out = gpipe(block, stacked, x_mb, extras=extras, x_spec=x_spec,
+                extras_spec=extras_spec, mesh=mesh)
+    return out.reshape(x.shape)
+
+
 def microbatch(batch, num_microbatches: int):
     """(B, ...) -> (M, B/M, ...) over every leaf."""
     return jax.tree_util.tree_map(

@@ -184,12 +184,16 @@ def sa_search(space: Dict[str, Sequence], eval_fn: Callable[[dict], float],
     best, best_r = dict(cur), cur_r
     temp = init_temp
     history = [(dict(cur), cur_r)]
+    # only knobs with >1 choice can move; single-choice knobs would waste
+    # a full eval per no-op mutation (eval_fn is a training run in NAS)
+    mutable = [k for k in keys if len(space[k]) > 1]
+    if not mutable:
+        return best, best_r, history
     for _ in range(iters):
         cand = dict(cur)
-        k = keys[int(rng.integers(len(keys)))]
+        k = mutable[int(rng.integers(len(mutable)))]
         choices = [c for c in space[k] if c != cand[k]]
-        if choices:
-            cand[k] = choices[int(rng.integers(len(choices)))]
+        cand[k] = choices[int(rng.integers(len(choices)))]
         r = float(eval_fn(cand))
         if r >= cur_r or rng.random() < _np.exp((r - cur_r)
                                                 / max(temp, 1e-8)):
