@@ -107,24 +107,82 @@ def cifar10(data_dir, split="train"):
     return reader
 
 
+def _build_dict(token_iter, cutoff=0, unk="<unk>"):
+    """Frequency-sorted vocab (shared by the imdb/wmt builders): most
+    frequent word gets id 0, ``unk`` always gets the LAST id — literal
+    occurrences of the unk token in the corpus are excluded so its id is
+    never shadowed (an id hole would overflow an embedding table sized
+    len(dict))."""
+    freq = {}
+    for w in token_iter:
+        freq[w] = freq.get(w, 0) + 1
+    words = sorted((w for w, c in freq.items()
+                    if c > cutoff and w != unk),
+                   key=lambda w: (-freq[w], w))
+    d = {w: i for i, w in enumerate(words)}
+    d[unk] = len(words)
+    return d
+
+
 def imdb_build_dict(data_dir, cutoff=1):
     """Frequency-sorted word dict over train pos/neg text files
     (paddle.dataset.imdb.word_dict parity; <unk> gets the last id)."""
-    freq = {}
-    for sub in ("train/pos", "train/neg"):
-        d = os.path.join(data_dir, sub)
-        if not os.path.isdir(d):
+    def tokens():
+        for sub in ("train/pos", "train/neg"):
+            d = os.path.join(data_dir, sub)
+            if not os.path.isdir(d):
+                raise FileNotFoundError(
+                    f"{d} missing — stage an extracted aclImdb tree")
+            for name in sorted(os.listdir(d)):
+                with open(os.path.join(d, name), errors="ignore") as f:
+                    yield from f.read().lower().split()
+
+    return _build_dict(tokens(), cutoff=cutoff)
+
+
+def wmt_parallel(data_dir, src_lang="en", tgt_lang="de", split="train", *,
+                 src_dict=None, tgt_dict=None, unk="<unk>"):
+    """Parallel-corpus reader (paddle.dataset.wmt14/wmt16 parity): reads
+    ``{split}.{src_lang}`` / ``{split}.{tgt_lang}`` line-aligned text plus
+    vocab dicts, yielding (src_ids, tgt_ids) int64 arrays. Build dicts
+    with :func:`wmt_build_dict` or pass pre-built {word: id} maps."""
+    src_path = os.path.join(data_dir, f"{split}.{src_lang}")
+    tgt_path = os.path.join(data_dir, f"{split}.{tgt_lang}")
+    for p in (src_path, tgt_path):
+        if not os.path.exists(p):
             raise FileNotFoundError(
-                f"{d} missing — stage an extracted aclImdb tree")
-        for name in sorted(os.listdir(d)):
-            with open(os.path.join(d, name), errors="ignore") as f:
-                for w in f.read().lower().split():
-                    freq[w] = freq.get(w, 0) + 1
-    words = sorted((w for w, c in freq.items() if c > cutoff),
-                   key=lambda w: (-freq[w], w))
-    word_idx = {w: i for i, w in enumerate(words)}
-    word_idx["<unk>"] = len(words)
-    return word_idx
+                f"{p} missing — stage line-aligned parallel text locally "
+                "(zero-egress environment)")
+    if src_dict is None:
+        src_dict = wmt_build_dict([src_path], unk=unk)
+    if tgt_dict is None:
+        tgt_dict = wmt_build_dict([tgt_path], unk=unk)
+
+    def to_ids(line, d):
+        u = d[unk]
+        return np.asarray([d.get(w, u) for w in line.split()], np.int64)
+
+    def reader():
+        with open(src_path, errors="ignore") as fs, \
+                open(tgt_path, errors="ignore") as ft:
+            # strict: a line-count mismatch is corpus MISALIGNMENT, not
+            # something to silently truncate away
+            for ls, lt in zip(fs, ft, strict=True):
+                yield to_ids(ls.strip(), src_dict), \
+                    to_ids(lt.strip(), tgt_dict)
+
+    return reader
+
+
+def wmt_build_dict(paths, cutoff=0, unk="<unk>"):
+    """Frequency-sorted vocab over text files (wmt16 build_dict parity)."""
+    def tokens():
+        for p in paths:
+            with open(p, errors="ignore") as f:
+                for line in f:
+                    yield from line.split()
+
+    return _build_dict(tokens(), cutoff=cutoff, unk=unk)
 
 
 def imdb(data_dir, word_idx, split="train"):

@@ -131,6 +131,112 @@ class HeartbeatMonitor:
         self._stop.set()
 
 
+class ElasticCoordinator:
+    """Worker-process supervisor: spawn N ranks, watch for failures,
+    respawn crashed ranks (same rank id) until the job finishes or the
+    restart budget is spent.
+
+    Reference mapping (SURVEY.md §5.3): fluid's fault tolerance pairs the
+    pserver-side LostWorkerMonitor (heart_beat_monitor.h:54) with
+    cloud-side restart policy; here detection is HeartbeatMonitor /
+    process exit, and THIS is the restart policy half: a host-side
+    coordinator owning the worker processes. Workers are expected to
+    resume from their latest checkpoint on restart (io.CheckpointManager
+    pattern — see tests/test_dist_multiprocess.py for the full loop).
+
+    ``spawn_fn(rank, attempt) -> subprocess.Popen`` creates a worker;
+    ``success_rc`` exits that count as done; every other exit triggers a
+    respawn while ``max_restarts`` allows.
+
+    ``gang=True`` (default): ANY failure kills every worker and respawns
+    the whole gang at attempt+1 — required for SPMD jobs, where a
+    ``jax.distributed`` coordination service cannot admit a lone
+    rejoining rank; training resumes from the latest checkpoint.
+    ``gang=False`` restarts ranks individually (independent workers,
+    e.g. pserver clients).
+    """
+
+    def __init__(self, spawn_fn, num_workers: int, *,
+                 max_restarts: int = 2, poll_s: float = 0.2,
+                 success_rc: tuple = (0,), gang: bool = True,
+                 log_fn=print):
+        self.spawn_fn = spawn_fn
+        self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self.poll_s = poll_s
+        self.success_rc = tuple(success_rc)
+        self.gang = gang
+        self.restarts = 0                      # gang restarts
+        self.rank_restarts = [0] * num_workers
+        self._log = log_fn
+
+    def _spawn_all(self, attempt):
+        return [self.spawn_fn(r, attempt) for r in range(self.num_workers)]
+
+    def run(self, timeout_s: float = 600.0) -> bool:
+        """Supervise until every rank succeeds (True) or the restart
+        budget / deadline is exhausted (False; survivors terminated)."""
+        import time as _time
+
+        procs = self._spawn_all(0)
+        done = [False] * self.num_workers
+        deadline = _time.monotonic() + timeout_s
+        try:
+            while not all(done):
+                if _time.monotonic() > deadline:
+                    self._log("[elastic] deadline exceeded")
+                    return False
+                failed = None
+                for r, p in enumerate(procs):
+                    if done[r] or p.poll() is None:
+                        continue
+                    rc = p.returncode
+                    if rc in self.success_rc:
+                        done[r] = True
+                    else:
+                        failed = (r, rc)
+                        break
+                if failed is None:
+                    _time.sleep(self.poll_s)
+                    continue
+                r, rc = failed
+                if self.gang:
+                    if self.restarts >= self.max_restarts:
+                        self._log(f"[elastic] rank {r} failed rc={rc}; "
+                                  "gang restart budget exhausted")
+                        return False
+                    self.restarts += 1
+                    self._log(f"[elastic] rank {r} failed rc={rc}; gang "
+                              f"restart {self.restarts}/"
+                              f"{self.max_restarts} (kill + respawn all, "
+                              "resume from checkpoint)")
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    for p in procs:
+                        p.wait()
+                    procs = self._spawn_all(self.restarts)
+                    done = [False] * self.num_workers
+                else:
+                    if self.rank_restarts[r] >= self.max_restarts:
+                        self._log(f"[elastic] rank {r} failed rc={rc}, "
+                                  "restart budget exhausted")
+                        return False
+                    self.rank_restarts[r] += 1
+                    self._log(f"[elastic] rank {r} failed rc={rc}; "
+                              f"restart {self.rank_restarts[r]}/"
+                              f"{self.max_restarts}")
+                    procs[r] = self.spawn_fn(r, self.rank_restarts[r])
+            return True
+        finally:
+            for r, p in enumerate(procs):
+                if not done[r] and p.poll() is None:
+                    p.kill()
+            for r, p in enumerate(procs):
+                if not done[r]:
+                    p.wait()  # reap: no zombies in the supervisor
+
+
 def local_shard(batch, *, index: Optional[int] = None,
                 num: Optional[int] = None):
     """Slice a host's shard out of a global host batch (the data-feed

@@ -21,8 +21,19 @@ import jax
 import numpy as np
 
 
+# marker KEY for empty dict nodes: without it, a state containing an
+# empty container (e.g. SGD's opt slots {}) silently CHANGES pytree
+# structure across save/load — which then breaks jit caches / pjit
+# sharding prefixes on resume. The marker lives in the KEY namespace
+# (\x00 cannot appear in a normal field name), so no leaf VALUE can
+# collide with it.
+_EMPTY_KEY = "\x00empty"
+
+
 def _flatten(tree, prefix=()):
     if isinstance(tree, dict):
+        if not tree:
+            return {"/".join(prefix + (_EMPTY_KEY,)): np.int8(0)}
         out = {}
         for k in sorted(tree):
             out.update(_flatten(tree[k], prefix + (str(k),)))
@@ -37,6 +48,8 @@ def _unflatten(flat):
         node = tree
         for p in parts[:-1]:
             node = node.setdefault(p, {})
+        if parts[-1] == _EMPTY_KEY:
+            continue  # the walk above materialized the empty dict
         node[parts[-1]] = val
     return tree
 

@@ -110,6 +110,114 @@ def sequence_unpad(x, lengths):
     return [xs[i, :ls[i]] for i in range(xs.shape[0])]
 
 
+@register_op("sequence_conv")
+def sequence_conv(x, lengths, filter_weight, context_start=-1,
+                  padding_value=0.0):
+    """Context-window convolution over time (sequence_conv_op): at each
+    step t, the rows x[t+context_start : t+context_start+ctx_len] are
+    concatenated and matmul'd with ``filter_weight``
+    ((ctx_len*D, F)). Positions beyond each row's length are masked.
+    x: (B, T, D) -> (B, T, F)."""
+    b, t, d = x.shape
+    ctx_len = filter_weight.shape[0] // d
+    mask = sequence_mask(lengths, t, x.dtype)[..., None]
+    xm = x * mask + padding_value * (1 - mask)
+    cols = []
+    for j in range(ctx_len):
+        off = context_start + j
+        shifted = jnp.roll(xm, -off, axis=1)
+        pos = jnp.arange(t)
+        valid = (pos + off >= 0) & (pos + off < t)
+        cols.append(jnp.where(valid[None, :, None], shifted,
+                              padding_value))
+    ctx = jnp.concatenate(cols, axis=-1)           # (B, T, ctx_len*D)
+    out = jnp.einsum("btc,cf->btf", ctx, filter_weight)
+    return out * mask
+
+
+@register_op("sequence_slice")
+def sequence_slice(x, lengths, offsets, slice_lengths):
+    """Per-row slice of the valid prefix (sequence_slice_op): row i keeps
+    x[i, offsets[i] : offsets[i]+slice_lengths[i]], left-aligned into the
+    same (B, T, ...) shape with zeros after; returns (out, new_lengths)."""
+    b, t = x.shape[:2]
+    pos = jnp.arange(t)
+    src = offsets[:, None] + pos[None, :]          # (B, T) gather index
+    valid = (pos[None, :] < slice_lengths[:, None]) & \
+        (src < lengths[:, None])
+    src = jnp.clip(src, 0, t - 1)
+    if x.ndim == 2:
+        gathered = jnp.take_along_axis(x, src, axis=1)
+    else:
+        gathered = jnp.take_along_axis(
+            x, src[..., None].repeat(x.shape[-1], -1), axis=1)
+    shape = valid.shape + (1,) * (x.ndim - 2)
+    out = jnp.where(valid.reshape(shape), gathered, 0)
+    new_len = jnp.minimum(slice_lengths,
+                          jnp.maximum(lengths - offsets, 0))
+    return out, new_len
+
+
+@register_op("sequence_erase")
+def sequence_erase(x, lengths, tokens):
+    """Remove every occurrence of ``tokens`` from each row's valid prefix
+    (sequence_erase_op), left-compacting survivors. x: (B, T) int;
+    returns (out (B, T), new_lengths)."""
+    b, t = x.shape
+    tokens = jnp.asarray(tokens).reshape(-1)
+    valid = sequence_mask(lengths, t, jnp.bool_)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable left-compaction: sort by (dropped, original position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + 1),
+                        axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1)
+    out_mask = jnp.arange(t)[None, :] < new_len[:, None]
+    return jnp.where(out_mask, compacted, 0), new_len
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(x, lengths, win_size, pad_value=0):
+    """Sliding windows over each row (sequence_enumerate_op): output
+    (B, T, win_size) where out[b, t] = x[b, t:t+win], positions past the
+    row's length filled with ``pad_value``."""
+    b, t = x.shape
+    wins = []
+    for j in range(win_size):
+        shifted = jnp.roll(x, -j, axis=1)
+        valid = (jnp.arange(t)[None, :] + j) < lengths[:, None]
+        wins.append(jnp.where(valid, shifted, pad_value))
+    return jnp.stack(wins, axis=-1)
+
+
+@register_op("sequence_concat")
+def sequence_concat(x, x_lengths, y, y_lengths, pad_value=0):
+    """Row-wise ragged concat (sequence_concat_op): row i becomes
+    x[i,:lx] ++ y[i,:ly], padded to Tx+Ty; returns (out, lengths).
+    x/y: (B, T) or (B, T, D)."""
+    b, tx = x.shape[:2]
+    ty = y.shape[1]
+    t_out = tx + ty
+    pos = jnp.arange(t_out)[None, :]
+    from_x = pos < x_lengths[:, None]
+    y_idx = jnp.clip(pos - x_lengths[:, None], 0, ty - 1)
+    x_idx = jnp.clip(pos, 0, tx - 1)
+
+    def gather(arr, idx):
+        if arr.ndim == 2:
+            return jnp.take_along_axis(arr, idx, axis=1)
+        return jnp.take_along_axis(
+            arr, idx[..., None].repeat(arr.shape[-1], -1), axis=1)
+
+    sel = from_x if x.ndim == 2 else from_x[..., None]
+    out = jnp.where(sel, gather(x, x_idx), gather(y, y_idx))
+    new_len = x_lengths + y_lengths
+    keep = pos < new_len[:, None]
+    if x.ndim == 3:
+        keep = keep[..., None]
+    return jnp.where(keep, out, pad_value), new_len
+
+
 # -- packed-segment variants (sequence packing for long-context training) --
 
 @register_op("segment_sum")

@@ -32,8 +32,13 @@ def main():
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--steps", type=int, default=5)
-    ap.add_argument("--mode", choices=["parity", "stall"], default="parity")
+    ap.add_argument("--mode", choices=["parity", "stall", "elastic"],
+                    default="parity")
     ap.add_argument("--die-at", type=int, default=-1)
+    # elastic mode: checkpoint every step; crash rank 1 at --die-at on
+    # attempt 0 only; later attempts resume from the checkpoint
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--attempt", type=int, default=0)
     args = ap.parse_args()
 
     import jax
@@ -127,10 +132,22 @@ def main():
         run, placed = papi.shard_train_step(
             step_fn, mesh, state, batch_spec=P(("dp", "fsdp")))
         state = placed
+
+        start = 0
+        if args.mode == "elastic" and args.ckpt \
+                and os.path.exists(args.ckpt):
+            from paddle_tpu import io as io_lib
+            snap = io_lib.load_params(args.ckpt)
+            state = jax.device_put(snap["state"])
+            start = int(snap["step"])
+            out["losses"] = list(snap["losses"])
+            out["events"].append({"kind": "resumed", "step": start})
+
         try:
-            for i in range(args.steps):
-                if args.mode == "stall" and args.rank > 0 \
-                        and i == args.die_at:
+            for i in range(start, args.steps):
+                if args.rank > 0 and i == args.die_at and (
+                        args.mode == "stall"
+                        or (args.mode == "elastic" and args.attempt == 0)):
                     os._exit(9)  # simulated crash, no cleanup
                 batch = to_device(global_batch(i))
                 state, metrics = run(state, **batch)
@@ -139,6 +156,13 @@ def main():
                 if monitor is not None:
                     monitor.beat(i)
                     time.sleep(0.3)  # give the parent time to observe
+                if args.mode == "elastic" and args.ckpt and args.rank == 0:
+                    from paddle_tpu import io as io_lib
+                    tmp = f"{args.ckpt}.tmp"
+                    io_lib.save_params(
+                        {"state": jax.device_get(state), "step": i + 1,
+                         "losses": out["losses"]}, tmp)
+                    os.replace(tmp, args.ckpt)  # atomic: never half-saved
         except Exception as e:  # peer death surfaces as a collective error
             out["events"].append({"kind": "peer_failure",
                                   "error": f"{type(e).__name__}: {e}"[:300]})

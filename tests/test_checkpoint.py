@@ -50,6 +50,25 @@ def test_manager_save_restore_resume(tmp_path):
     mgr2.close()
 
 
+def test_empty_dict_nodes_survive_roundtrip(tmp_path):
+    """A state pytree containing an EMPTY container (SGD's opt slots {})
+    must come back with identical structure — a silent structure change
+    breaks pjit sharding prefixes on resume (found by the elastic gang
+    restart test)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import io as io_lib
+
+    state = {"params": {"w": jnp.ones((2,))},
+             "opt": {"slots": {}, "step": jnp.zeros((), jnp.int32)}}
+    p = str(tmp_path / "s.pkl")
+    io_lib.save_params(state, p)
+    back = io_lib.load_params(p)
+    assert jax.tree_util.tree_structure(back) == \
+        jax.tree_util.tree_structure(state)
+    assert back["opt"]["slots"] == {}
+
+
 def test_max_to_keep_gc(tmp_path):
     state, step, x, y = _setup()
     mgr = io.CheckpointManager(str(tmp_path / "c"), max_to_keep=2)
