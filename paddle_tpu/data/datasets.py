@@ -157,6 +157,11 @@ def wmt_parallel(data_dir, src_lang="en", tgt_lang="de", split="train", *,
         src_dict = wmt_build_dict([src_path], unk=unk)
     if tgt_dict is None:
         tgt_dict = wmt_build_dict([tgt_path], unk=unk)
+    for name, d in (("src_dict", src_dict), ("tgt_dict", tgt_dict)):
+        if unk not in d:
+            raise ValueError(
+                f"{name} has no {unk!r} entry — pre-built vocabs must "
+                "include the unk token (or pass unk= matching theirs)")
 
     def to_ids(line, d):
         u = d[unk]

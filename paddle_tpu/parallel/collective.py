@@ -163,6 +163,41 @@ def ppermute(x, axis: str, perm, *, mesh: Optional[Mesh] = None):
                      out_specs=P(*[None] * x.ndim))(x)
 
 
+def hierarchical_all_reduce(x, *, ici_axis: str = mesh_lib.DP,
+                            dcn_axis: str = "dcn", scatter_axis: int = 0,
+                            mesh: Optional[Mesh] = None):
+    """Two-level all-reduce (hierarchical allreduce parity,
+    platform/nccl_helper.h + nccl_op_handle.h:124 — there: intra-node
+    NCCL ring then inter-node ring over fewer, fatter links).
+
+    TPU topology analog: ``ici_axis`` spans the fast in-slice links,
+    ``dcn_axis`` the slower cross-slice network. Schedule:
+
+        reduce_scatter over ICI  ->  all_reduce the 1/n shard over DCN
+        ->  all_gather over ICI
+
+    so the DCN leg moves 1/|ici| of the bytes — exactly the NCCL
+    hierarchical trick. Numerically equal to one psum over both axes
+    (asserted by tests); XLA may also derive this itself, the explicit
+    form is for topologies/compilers where it does not.
+
+    ``x``: per-member local value (replicated layout); dim
+    ``scatter_axis`` must be divisible by the ICI axis size.
+    """
+    m = _mesh(mesh)
+
+    def body(v):
+        shard = jax.lax.psum_scatter(v, ici_axis,
+                                     scatter_dimension=scatter_axis,
+                                     tiled=True)
+        shard = jax.lax.psum(shard, dcn_axis)
+        return jax.lax.all_gather(shard, ici_axis, axis=scatter_axis,
+                                  tiled=True)
+
+    return shard_map(body, mesh=m, in_specs=P(*[None] * x.ndim),
+                     out_specs=P(*[None] * x.ndim))(x)
+
+
 def barrier(axis: AxisArg = mesh_lib.DP, *, mesh: Optional[Mesh] = None):
     """send_barrier/fetch_barrier parity: a no-op psum forcing rendezvous."""
     return all_reduce(jnp.zeros(()), axis, mesh=mesh)

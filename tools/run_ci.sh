@@ -1,0 +1,21 @@
+#!/bin/sh
+# CI entry (SURVEY §7 step 11: surface freeze + test gate).
+# Runs on a virtual 8-device CPU mesh; no network, no TPU required.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== api surface freeze =="
+python tools/gen_api_spec.py > /tmp/api_spec.now
+diff -u api_spec.txt /tmp/api_spec.now || {
+  echo "API surface changed: regenerate api_spec.txt in the same commit"
+  exit 1
+}
+
+echo "== test suite =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q
+
+echo "== multichip dryrun =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI OK"
