@@ -86,8 +86,14 @@ def main(argv=None):
                 args.log_dir, f"rank{rank}.a{attempt}.out"), "w")
             stderr = open(os.path.join(
                 args.log_dir, f"rank{rank}.a{attempt}.err"), "w")
-        return subprocess.Popen(cmd, env=env, stdout=stdout,
+        proc = subprocess.Popen(cmd, env=env, stdout=stdout,
                                 stderr=stderr)
+        # the child owns its descriptors now; keeping the parent copies
+        # open leaks 2 fds per worker per restart attempt
+        for f in (stdout, stderr):
+            if f is not None:
+                f.close()
+        return proc
 
     if args.elastic:
         from paddle_tpu.fleet import ElasticCoordinator

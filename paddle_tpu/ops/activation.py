@@ -14,6 +14,8 @@ __all__ = [
     "square", "sqrt", "rsqrt", "abs", "ceil", "floor", "round", "reciprocal",
     "sin", "cos", "swish", "silu", "leaky_relu", "elu", "relu6",
     "hard_sigmoid", "hard_swish", "prelu", "pow", "clip",
+    "selu", "mish", "softshrink", "hard_shrink", "tanh_shrink",
+    "thresholded_relu", "logsigmoid", "stanh",
 ]
 
 
@@ -84,3 +86,55 @@ def pow(x, factor=1.0):
 @register_op("clip", reference=lambda x, min, max: np.clip(x, min, max))
 def clip(x, min, max):  # noqa: A002 - fluid op signature
     return jnp.clip(x, min, max)
+
+
+# -- activation long tail (activation_op.cc breadth) ------------------------
+
+@register_op("selu", reference=lambda x, scale=1.0507009873554805,
+             alpha=1.6732632423543772:
+             scale * np.where(x > 0, x, alpha * (np.exp(x) - 1)))
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register_op("mish", reference=lambda x:
+             x * np.tanh(np.log1p(np.exp(x))))
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("softshrink", reference=lambda x, lambda_=0.5:
+             np.where(x > lambda_, x - lambda_,
+                      np.where(x < -lambda_, x + lambda_, 0.0)))
+def softshrink(x, lambda_=0.5):
+    return jnp.where(x > lambda_, x - lambda_,
+                     jnp.where(x < -lambda_, x + lambda_, 0.0))
+
+
+@register_op("hard_shrink", reference=lambda x, threshold=0.5:
+             np.where(np.abs(x) > threshold, x, 0.0))
+def hard_shrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("tanh_shrink", reference=lambda x: x - np.tanh(x))
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("thresholded_relu", reference=lambda x, threshold=1.0:
+             np.where(x > threshold, x, 0.0))
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@register_op("logsigmoid", reference=lambda x:
+             -np.log1p(np.exp(-np.abs(x))) + np.minimum(x, 0))
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("stanh", reference=lambda x, scale_a=0.67, scale_b=1.7159:
+             scale_b * np.tanh(scale_a * x))
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)

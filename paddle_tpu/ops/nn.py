@@ -406,3 +406,141 @@ def grid_sampler(x, grid, data_format="NCHW"):
     if nchw:
         out = jnp.transpose(out, (0, 3, 1, 2))
     return out
+
+
+# -- nn long tail (root-op breadth) -----------------------------------------
+
+@register_op("group_norm")
+def group_norm(x, scale=None, bias=None, groups=32, epsilon=1e-5,
+               data_format="NHWC"):
+    """group_norm_op. x: (N, H, W, C) NHWC (reference is NCHW; the TPU
+    layout is channel-last — pass data_format='NCHW' for parity shims)."""
+    x = _to_nhwc(x, data_format)
+    n, h, w, c = x.shape
+    g = x.reshape(n, h, w, groups, c // groups)
+    mean = g.mean(axis=(1, 2, 4), keepdims=True)
+    var = g.var(axis=(1, 2, 4), keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+    out = g.reshape(n, h, w, c)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return _from_nhwc(out, data_format)
+
+
+@register_op("instance_norm")
+def instance_norm(x, scale=None, bias=None, epsilon=1e-5,
+                  data_format="NHWC"):
+    """instance_norm_op: per-(sample, channel) spatial normalization."""
+    x = _to_nhwc(x, data_format)
+    mean = x.mean(axis=(1, 2), keepdims=True)
+    var = x.var(axis=(1, 2), keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return _from_nhwc(out, data_format)
+
+
+@register_op("lrn")
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NHWC"):
+    """lrn_op (AlexNet local response norm) across channels."""
+    x = _to_nhwc(x, data_format)
+    sq = x * x
+    half = n // 2
+    pads = [(0, 0)] * 3 + [(half, n - 1 - half)]
+    sq = jnp.pad(sq, pads)
+    window = sum(sq[..., i:i + x.shape[-1]] for i in range(n))
+    out = x / jnp.power(k + alpha * window, beta)
+    return _from_nhwc(out, data_format)
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=-1):
+    """maxout_op: channel dim C -> C/groups by max over each group."""
+    c = x.shape[axis]
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@register_op("pad2d")
+def pad2d(x, paddings, mode="constant", pad_value=0.0,
+          data_format="NHWC"):
+    """pad2d_op: spatial padding (constant/reflect/edge).
+    paddings: (top, bottom, left, right)."""
+    x = _to_nhwc(x, data_format)
+    t, b, l, r = paddings
+    cfg = ((0, 0), (t, b), (l, r), (0, 0))
+    if mode == "constant":
+        out = jnp.pad(x, cfg, constant_values=pad_value)
+    else:
+        out = jnp.pad(x, cfg, mode={"reflect": "reflect",
+                                    "edge": "edge"}[mode])
+    return _from_nhwc(out, data_format)
+
+
+@register_op("affine_grid")
+def affine_grid(theta, out_shape):
+    """affine_grid_op (STN, pairs with grid_sampler): theta (N, 2, 3) ->
+    normalized sampling grid (N, H, W, 2) with align_corners semantics."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    grid = jnp.einsum("bnk,bjk->bnj", jnp.broadcast_to(
+        base, (n, h * w, 3)), theta)            # (N, HW, 2)
+    return grid.reshape(n, h, w, 2)
+
+
+@register_op("affine_channel")
+def affine_channel(x, scale, bias, data_format="NHWC"):
+    """affine_channel_op: per-channel y = scale * x + bias (frozen-BN
+    form used by detection backbones)."""
+    x = _to_nhwc(x, data_format)
+    return _from_nhwc(x * scale + bias, data_format)
+
+
+@register_op("log_loss", reference=lambda pred, label, epsilon=1e-4:
+             -label * np.log(pred + epsilon)
+             - (1 - label) * np.log(1 - pred + epsilon))
+def log_loss(pred, label, epsilon=1e-4):
+    return -label * jnp.log(pred + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - pred + epsilon)
+
+
+@register_op("rank_loss", reference=lambda label, left, right:
+             np.log1p(np.exp(-np.abs(left - right)))
+             + np.maximum(left - right, 0) - label * (left - right))
+def rank_loss(label, left, right):
+    """rank_loss_op (RankNet pairwise). softplus form: log1p(exp(d))
+    overflows for d > ~88 in f32 and poisons grads with NaN."""
+    return jax.nn.softplus(left - right) - label * (left - right)
+
+
+@register_op("hinge_loss", reference=lambda logits, label:
+             np.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits))
+def hinge_loss(logits, label):
+    return jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)
+
+
+@register_op("cos_sim")
+def cos_sim(x, y, epsilon=1e-12):
+    """cos_sim_op: row-wise cosine similarity (B, D) -> (B, 1)."""
+    nx = jnp.linalg.norm(x, axis=-1, keepdims=True)
+    ny = jnp.linalg.norm(y, axis=-1, keepdims=True)
+    return (x * y).sum(-1, keepdims=True) / jnp.maximum(nx * ny, epsilon)
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """bilinear_tensor_product_op: out[:, k] = x W_k y^T.
+    x (B, M), y (B, N), weight (K, M, N) -> (B, K)."""
+    out = jnp.einsum("bm,kmn,bn->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out

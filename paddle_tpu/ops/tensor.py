@@ -245,3 +245,159 @@ def accuracy(logits_or_topk, label, k=1):
     lbl = label.reshape(-1, 1)
     correct = jnp.any(pred == lbl, axis=1)
     return jnp.mean(correct.astype(jnp.float32))
+
+
+# -- tensor long tail (root-op breadth) -------------------------------------
+
+@register_op("tril", reference=lambda x, diagonal=0: np.tril(x, diagonal))
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@register_op("triu", reference=lambda x, diagonal=0: np.triu(x, diagonal))
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@register_op("meshgrid", has_grad=False)
+def meshgrid(*xs, indexing="ij"):
+    """fluid meshgrid_op (default 'ij' like the reference)."""
+    return jnp.meshgrid(*xs, indexing=indexing)
+
+
+@register_op("kron", reference=np.kron)
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("unique", has_grad=False)
+def unique(x, return_counts=False):
+    """fluid unique_op: sorted unique values (+ counts). Static-shape
+    caveat: under jit, use size= via jnp.unique kwargs at call site."""
+    return jnp.unique(jnp.ravel(x), return_counts=return_counts)
+
+
+@register_op("nonzero", has_grad=False)
+def nonzero(x):
+    """where_index_op: indices of nonzero elements, (N, ndim). Host/eager
+    only (data-dependent shape)."""
+    return jnp.stack(jnp.nonzero(x), axis=-1)
+
+
+@register_op("index_select",
+             reference=lambda x, index, axis=0: np.take(x, index, axis))
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("index_sample", reference=lambda x, index:
+             np.take_along_axis(x, index, axis=1))
+def index_sample(x, index):
+    """index_sample_op: per-row gather — out[i, j] = x[i, index[i, j]]."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op("multiplex", reference=lambda index, *xs:
+             np.stack(xs)[index.ravel(), np.arange(index.size)])
+def multiplex(index, *xs):
+    """multiplex_op: row i of the output comes from candidate xs[index[i]]."""
+    stacked = jnp.stack(xs)                      # (C, B, ...)
+    idx = jnp.ravel(index)
+    return stacked[idx, jnp.arange(idx.shape[0])]
+
+
+@register_op("unfold", reference=None)
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    """unfold_op (im2col): (N, C, H, W) -> (N, C*kh*kw, L) like the
+    reference's NCHW layout."""
+    n, c, h, w = x.shape
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else kernel_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                       j * dw:j * dw + (ow - 1) * sw + 1:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)                # (N, C, kh*kw, oh, ow)
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("pixel_shuffle", reference=None)
+def pixel_shuffle(x, upscale_factor):
+    """pixel_shuffle_op: (N, C*r^2, H, W) -> (N, C, H*r, W*r) (NCHW)."""
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("shuffle_channel", reference=None)
+def shuffle_channel(x, group):
+    """shuffle_channel_op (ShuffleNet): (N, C, H, W) group interleave."""
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+@register_op("temporal_shift", reference=None)
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    """temporal_shift_op (TSM): x (N*T, C, H, W); shift 1/4 channels one
+    frame back, 1/4 one frame forward, rest unchanged."""
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    x = x.reshape(n, t, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, c1:c2]), x[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, x[:, :, c2:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+@register_op("crop", reference=None)
+def crop(x, offsets, shape):
+    """crop_op / crop_tensor_op: static slice at offsets with out shape."""
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("gaussian_random", has_grad=False)
+def gaussian_random(key, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    """gaussian_random_op — FUNCTIONAL: the PRNG key is explicit (no
+    global generator state on TPU; fluid's seed attr becomes the key)."""
+    return mean + std * jax.random.normal(key, tuple(shape), dtype)
+
+
+@register_op("uniform_random", has_grad=False)
+def uniform_random(key, shape, min=-1.0, max=1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, tuple(shape), dtype, min, max)
+
+
+@register_op("randint", has_grad=False)
+def randint(key, low, high, shape):
+    return jax.random.randint(key, tuple(shape), low, high)
+
+
+@register_op("randperm", has_grad=False)
+def randperm(key, n):
+    return jax.random.permutation(key, n)
+
+
+@register_op("shard_index", has_grad=False)
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """shard_index_op (PS-world id localization): ids owned by this shard
+    map to local ids, others to ignore_value."""
+    shard_size = (index_num + nshards - 1) // nshards
+    owner = x // shard_size
+    local = x % shard_size
+    return jnp.where(owner == shard_id, local, ignore_value)

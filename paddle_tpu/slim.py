@@ -156,6 +156,82 @@ def fsp_loss(student_pairs, teacher_pairs):
 
 
 # ---------------------------------------------------------------------------
+# post-training quantization (weight-only int8)
+# ---------------------------------------------------------------------------
+#
+# slim's quant story has two halves: quant-aware training (fake-quant +
+# STE, ops/quant.py) and post-training quantization of a trained model.
+# This is the PTQ half for serving: weights stored int8 + per-channel
+# scales (4x smaller artifacts, HBM-bandwidth relief), dequantized to the
+# compute dtype at load/use — the WeightQuantization path of
+# contrib/slim's quantization_pass.
+
+def quantize_weights_int8(params, *, predicate: Optional[Callable] = None,
+                          per_channel: bool = True):
+    """Symmetric int8 weight quantization. Returns a pytree where each
+    quantized leaf becomes {"q": int8, "scale": f32, "axis": int}; other
+    leaves pass through. ``per_channel``: scale per output channel (last
+    dim) — the accuracy-preserving default."""
+    predicate = predicate or _prunable
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if predicate(path, tree):
+            w = jnp.asarray(tree)
+            if per_channel:
+                amax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)),
+                               keepdims=True)
+            else:
+                amax = jnp.max(jnp.abs(w))
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+            return {"q": q, "scale": scale.astype(jnp.float32),
+                    "axis": -1 if per_channel else None}
+        return tree
+
+    return walk(params, ())
+
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, dict) and set(node) == {"q", "scale", "axis"}
+
+
+def dequantize_weights(qparams, dtype=jnp.float32):
+    """Inverse of :func:`quantize_weights_int8`: rebuild a dense param
+    pytree in ``dtype`` (serve-time load path)."""
+
+    def walk(node):
+        if _is_qleaf(node):
+            return (node["q"].astype(jnp.float32)
+                    * node["scale"]).astype(dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def quantization_error(params, qparams) -> Dict[Tuple[str, ...], float]:
+    """Per-quantized-leaf relative L2 error — the accuracy-budget
+    diagnostic before shipping a quantized artifact."""
+    deq = dequantize_weights(qparams)
+    out = {}
+
+    def walk(a, b, q, path):
+        if isinstance(a, dict):
+            for k in a:
+                walk(a[k], b[k], q[k], path + (k,))
+        elif _is_qleaf(q):
+            num = float(jnp.linalg.norm((a - b).ravel()))
+            den = float(jnp.linalg.norm(jnp.asarray(a).ravel())) or 1.0
+            out[path] = num / den
+
+    walk(params, deq, qparams, ())
+    return out
+
+
+# ---------------------------------------------------------------------------
 # NAS (light): simulated-annealing architecture search
 # ---------------------------------------------------------------------------
 

@@ -70,6 +70,16 @@ def _sample_args(name):
         "isfinite": (x,), "isnan": (x,),
         "eye": (4,), "diag": (randn(4),),
         "einsum": ("ij,jk->ik", randn(3, 4), randn(4, 5)),
+        "kron": (randn(2, 3), randn(3, 2)),
+        "index_select": (randn(5, 3), RNG.randint(0, 5, (4,))),
+        "index_sample": (randn(4, 6), RNG.randint(0, 6, (4, 3))),
+        "multiplex": (RNG.randint(0, 2, (4,)), randn(4, 3), randn(4, 3)),
+        "log_loss": (np.abs(randn(4, 1)) % 0.8 + 0.1,
+                     RNG.randint(0, 2, (4, 1)).astype(np.float32)),
+        "rank_loss": (RNG.randint(0, 2, (4, 1)).astype(np.float32),
+                      randn(4, 1), randn(4, 1)),
+        "hinge_loss": (randn(4, 1),
+                       RNG.randint(0, 2, (4, 1)).astype(np.float32)),
     }
     if name.startswith("elementwise_"):
         return (randn(4, 6), randn(4, 6))

@@ -1,0 +1,172 @@
+"""Root-op breadth: long-tail ops without numpy registry references
+(norms, spatial rearrangers, STN pair, random ops, PS id localization)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import nn as N
+from paddle_tpu.ops import tensor as T
+
+RNG = np.random.RandomState(7)
+
+
+def randn(*s):
+    return RNG.randn(*s).astype(np.float32)
+
+
+class TestNorms:
+    def test_group_norm_matches_manual(self):
+        x = randn(2, 4, 4, 8)
+        out = N.group_norm(jnp.asarray(x), groups=4)
+        g = x.reshape(2, 4, 4, 4, 2)
+        mean = g.mean(axis=(1, 2, 4), keepdims=True)
+        var = g.var(axis=(1, 2, 4), keepdims=True)
+        ref = ((g - mean) / np.sqrt(var + 1e-5)).reshape(2, 4, 4, 8)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_instance_norm_zero_mean_unit_var(self):
+        x = randn(2, 6, 6, 3) * 5 + 2
+        out = np.asarray(N.instance_norm(jnp.asarray(x)))
+        np.testing.assert_allclose(out.mean(axis=(1, 2)), 0.0, atol=1e-4)
+        np.testing.assert_allclose(out.var(axis=(1, 2)), 1.0, atol=1e-2)
+
+    def test_group_norm_nchw_roundtrip(self):
+        x = randn(2, 8, 4, 4)
+        out = N.group_norm(jnp.asarray(x), groups=2, data_format="NCHW")
+        ref = N.group_norm(jnp.asarray(x.transpose(0, 2, 3, 1)), groups=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref).transpose(0, 3, 1, 2),
+                                   rtol=1e-5)
+
+    def test_lrn_matches_manual(self):
+        x = randn(1, 2, 2, 6)
+        out = np.asarray(N.lrn(jnp.asarray(x), n=3, k=1.0, alpha=0.1,
+                               beta=0.5))
+        sq = np.pad(x * x, [(0, 0)] * 3 + [(1, 1)])
+        win = sum(sq[..., i:i + 6] for i in range(3))
+        ref = x / np.power(1.0 + 0.1 * win, 0.5)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestSpatial:
+    def test_maxout(self):
+        x = jnp.asarray([[1.0, 5.0, 2.0, 8.0]])
+        np.testing.assert_allclose(
+            np.asarray(N.maxout(x, groups=2)), [[5.0, 8.0]])
+
+    def test_pad2d_modes(self):
+        x = jnp.asarray(randn(1, 2, 2, 1))
+        c = N.pad2d(x, (1, 1, 1, 1), mode="constant", pad_value=9.0)
+        assert c.shape == (1, 4, 4, 1) and float(c[0, 0, 0, 0]) == 9.0
+        r = N.pad2d(x, (1, 0, 0, 0), mode="reflect")
+        np.testing.assert_allclose(np.asarray(r[0, 0]),
+                                   np.asarray(x[0, 1]))
+        e = N.pad2d(x, (1, 0, 0, 0), mode="edge")
+        np.testing.assert_allclose(np.asarray(e[0, 0]),
+                                   np.asarray(x[0, 0]))
+
+    def test_pixel_shuffle_inverts_space_to_depth(self):
+        x = randn(2, 12, 4, 4)
+        out = np.asarray(T.pixel_shuffle(jnp.asarray(x), 2))
+        assert out.shape == (2, 3, 8, 8)
+        # element mapping: out[n, c, h*r+i, w*r+j] == x[n, c*r^2 + i*r + j, h, w]
+        assert out[0, 1, 2 * 2 + 1, 3 * 2] == pytest.approx(
+            x[0, 1 * 4 + 1 * 2 + 0, 2, 3])
+
+    def test_shuffle_channel_roundtrip(self):
+        x = randn(1, 6, 2, 2)
+        once = T.shuffle_channel(jnp.asarray(x), 2)
+        back = T.shuffle_channel(once, 3)   # inverse group count
+        np.testing.assert_allclose(np.asarray(back), x)
+
+    def test_temporal_shift_moves_frames(self):
+        x = randn(4, 4, 2, 2)  # n=2 t=2 c=4
+        out = np.asarray(T.temporal_shift(jnp.asarray(x), seg_num=2,
+                                          shift_ratio=0.25))
+        xs = x.reshape(2, 2, 4, 2, 2)
+        os_ = out.reshape(2, 2, 4, 2, 2)
+        # channel 0 shifted backward: frame 0 sees frame 1
+        np.testing.assert_allclose(os_[:, 0, 0], xs[:, 1, 0])
+        np.testing.assert_allclose(os_[:, 1, 0], 0.0)
+        # channel 1 shifted forward
+        np.testing.assert_allclose(os_[:, 1, 1], xs[:, 0, 1])
+        # remaining channels unchanged
+        np.testing.assert_allclose(os_[:, :, 2:], xs[:, :, 2:])
+
+    def test_unfold_reassembles_patches(self):
+        x = randn(1, 2, 4, 4)
+        out = np.asarray(T.unfold(jnp.asarray(x), kernel_size=2, stride=2))
+        assert out.shape == (1, 2 * 4, 4)
+        # first output column = top-left 2x2 patch, channel-major
+        patch = x[0, :, 0:2, 0:2].reshape(2, 4)  # (C, kh*kw)
+        np.testing.assert_allclose(out[0, :, 0], patch.reshape(-1))
+
+    def test_crop(self):
+        x = jnp.asarray(np.arange(16.0).reshape(4, 4))
+        out = T.crop(x, (1, 2), (2, 2))
+        np.testing.assert_allclose(np.asarray(out), [[6, 7], [10, 11]])
+
+
+class TestSTN:
+    def test_affine_grid_identity_plus_sampler(self):
+        """Identity theta -> grid_sampler reproduces the input (the STN
+        composition affine_grid + grid_sampler end to end)."""
+        theta = jnp.asarray([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]])
+        x = jnp.asarray(randn(1, 3, 5, 5))
+        grid = N.affine_grid(theta, (1, 3, 5, 5))
+        out = N.grid_sampler(x, grid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_affine_grid_translation(self):
+        # shift +2/(W-1)*2 in normalized x = one pixel right sample
+        theta = jnp.asarray([[[1.0, 0.0, 0.5], [0.0, 1.0, 0.0]]])
+        x = jnp.asarray(randn(1, 1, 4, 5))
+        out = N.grid_sampler(x, N.affine_grid(theta, (1, 1, 4, 5)))
+        np.testing.assert_allclose(np.asarray(out[0, 0, :, 0]),
+                                   np.asarray(x[0, 0, :, 1]), rtol=1e-5)
+
+
+class TestMisc:
+    def test_cos_sim(self):
+        x, y = randn(3, 4), randn(3, 4)
+        out = np.asarray(N.cos_sim(jnp.asarray(x), jnp.asarray(y)))
+        ref = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                                 * np.linalg.norm(y, axis=-1))
+        np.testing.assert_allclose(out[:, 0], ref, rtol=1e-5)
+
+    def test_bilinear_tensor_product(self):
+        x, y = randn(2, 3), randn(2, 4)
+        w = randn(5, 3, 4)
+        out = np.asarray(N.bilinear_tensor_product(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)))
+        ref = np.einsum("bm,kmn,bn->bk", x, w, y)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_shard_index(self):
+        ids = jnp.asarray([0, 5, 10, 15])
+        out = T.shard_index(ids, index_num=16, nshards=4, shard_id=1)
+        np.testing.assert_array_equal(np.asarray(out), [-1, 1, -1, -1])
+
+    def test_unique_nonzero_meshgrid(self):
+        u, c = T.unique(jnp.asarray([3, 1, 3, 2]), return_counts=True)
+        np.testing.assert_array_equal(np.asarray(u), [1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(c), [1, 1, 2])
+        nz = T.nonzero(jnp.asarray([[0, 1], [2, 0]]))
+        np.testing.assert_array_equal(np.asarray(nz), [[0, 1], [1, 0]])
+        gx, gy = T.meshgrid(jnp.arange(2), jnp.arange(3))
+        assert gx.shape == (2, 3)
+
+    def test_random_ops_functional(self):
+        k = jax.random.PRNGKey(0)
+        g = T.gaussian_random(k, (1000,), mean=2.0, std=0.5)
+        assert abs(float(g.mean()) - 2.0) < 0.1
+        u = T.uniform_random(k, (1000,), min=0.0, max=1.0)
+        assert 0.0 <= float(u.min()) and float(u.max()) <= 1.0
+        r = T.randint(k, 0, 10, (100,))
+        assert 0 <= int(r.min()) and int(r.max()) < 10
+        p = np.asarray(T.randperm(k, 10))
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))

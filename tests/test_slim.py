@@ -110,6 +110,41 @@ class TestPruning:
             assert table[0.9] >= table[0.0] - 1e-6, (path, table)
 
 
+class TestPostTrainingQuant:
+    def test_roundtrip_error_small(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        q = slim.quantize_weights_int8(params)
+        deq = slim.dequantize_weights(q)
+        # structure preserved; biases untouched
+        np.testing.assert_array_equal(
+            np.asarray(deq["fc1"]["bias"]),
+            np.asarray(params["fc1"]["bias"]))
+        errs = slim.quantization_error(params, q)
+        assert set(errs) == {("fc1", "weight"), ("fc2", "weight")}
+        assert all(e < 0.01 for e in errs.values()), errs
+
+    def test_int8_storage(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        q = slim.quantize_weights_int8(params)
+        assert q["fc1"]["weight"]["q"].dtype == jnp.int8
+        # per-channel: one scale per output unit
+        assert q["fc1"]["weight"]["scale"].shape == (1, 64)
+
+    def test_model_outputs_close_after_quant(self):
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(16, 16)).astype(np.float32))
+        ref = model(params, x)
+        deq = slim.dequantize_weights(
+            slim.quantize_weights_int8(params))
+        got = model(deq, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.1, atol=0.02)
+
+
 class TestDistillation:
     def test_soft_label_loss_zero_when_equal(self):
         logits = jnp.asarray(np.random.default_rng(0).normal(size=(8, 10)))

@@ -5,8 +5,10 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "== api surface freeze =="
-python tools/gen_api_spec.py > /tmp/api_spec.now
-diff -u api_spec.txt /tmp/api_spec.now || {
+SPEC_NOW="$(mktemp)"   # unique per run: concurrent CI must not race
+trap 'rm -f "$SPEC_NOW"' EXIT
+python tools/gen_api_spec.py > "$SPEC_NOW"
+diff -u api_spec.txt "$SPEC_NOW" || {
   echo "API surface changed: regenerate api_spec.txt in the same commit"
   exit 1
 }
