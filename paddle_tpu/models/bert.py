@@ -12,6 +12,7 @@ dp×fsdp×tp×sp mesh unchanged.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
-from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.nn.module import Layer, LayerList, StackedLayers
 from paddle_tpu.nn.transformer import ACT_SPEC, TransformerEncoderLayer, _constrain
 from paddle_tpu.ops import activation as ops_act
 from paddle_tpu.ops import attention as ops_attn
@@ -44,6 +45,17 @@ class BertConfig:
     # Embeddings/heads stay outside the pipelined middle.
     pipeline: bool = False
     pp_microbatches: int = 2
+    # scan-over-layers param layout: encoder params stored as stacked
+    # (L, ...) leaves sharded over "pp" from init — one compiled block
+    # (faster compile), and pipeline stages own their rows by placement
+    # (no in-graph stack/reshard). Defaults on when pipeline is on.
+    # NOTE: this changes the checkpoint tree layout; convert older
+    # per-layer checkpoints with stack_encoder_params / unstack_.
+    stacked_layers: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.stacked_layers is None:
+            self.stacked_layers = self.pipeline
 
     @classmethod
     def base(cls, **kw):
@@ -64,6 +76,24 @@ class BertConfig:
         kw.setdefault("ffn_size", 64)
         kw.setdefault("max_position", 64)
         return cls(**kw)
+
+
+def stack_encoder_params(params, num_layers: int):
+    """Convert a LayerList-layout BERT param tree ("encoder"/"0"/... per
+    layer) to the stacked scan-over-layers layout — for loading
+    checkpoints saved before ``stacked_layers`` (or by non-stacked
+    configs) into a stacked model."""
+    enc = [params["bert"]["encoder"][str(i)] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *enc)
+    return dict(params, bert=dict(params["bert"], encoder=stacked))
+
+
+def unstack_encoder_params(params, num_layers: int):
+    """Inverse of :func:`stack_encoder_params`."""
+    enc = {str(i): jax.tree_util.tree_map(lambda x: x[i],
+                                          params["bert"]["encoder"])
+           for i in range(num_layers)}
+    return dict(params, bert=dict(params["bert"], encoder=enc))
 
 
 class BertEmbeddings(Layer):
@@ -98,13 +128,18 @@ class BertModel(Layer):
         super().__init__()
         self.cfg = cfg
         self.embeddings = BertEmbeddings(cfg)
-        self.encoder = LayerList([
-            TransformerEncoderLayer(
+
+        def make_layer():
+            return TransformerEncoderLayer(
                 cfg.hidden_size, cfg.num_heads, cfg.ffn_size,
                 dropout=cfg.dropout, attn_dropout=cfg.attn_dropout,
                 pre_ln=cfg.pre_ln, attn_impl=cfg.attn_impl)
-            for _ in range(cfg.num_layers)
-        ])
+
+        if cfg.stacked_layers:
+            self.encoder = StackedLayers(make_layer(), cfg.num_layers)
+        else:
+            self.encoder = LayerList(
+                [make_layer() for _ in range(cfg.num_layers)])
         self.pooler = Linear(cfg.hidden_size, cfg.hidden_size,
                              sharding=None)
 
@@ -122,6 +157,10 @@ class BertModel(Layer):
         x = _constrain(x, ACT_SPEC)
         if self.cfg.pipeline:
             x = self._encoder_pipelined(params, x, bias, keys[1:], training)
+        elif self.cfg.stacked_layers:
+            lkeys = (jnp.stack(keys[1:]) if keys[1] is not None else None)
+            x = self.encoder(params["encoder"], x, layer_keys=lkeys,
+                             bias=bias, training=training)
         else:
             for i, layer in enumerate(self.encoder):
                 x = layer(params["encoder"][str(i)], x, bias=bias,
@@ -145,11 +184,17 @@ class BertModel(Layer):
             extras_spec = P(*((None, ("dp", "fsdp"))
                               + (None,) * (extras.ndim - 2)))
 
-        block_layer = self.encoder[0]  # identical structure for all layers
+        if cfg.stacked_layers:
+            block_layer = self.encoder.template
+            enc_params = params["encoder"]       # pre-stacked (L, ...)
+        else:
+            block_layer = self.encoder[0]
+            enc_params = [params["encoder"][str(i)]
+                          for i in range(cfg.num_layers)]
         return pp_lib.gpipe_layer_stack(
             lambda lp, h, extra, k: block_layer(
                 lp, h, bias=extra, key=k, training=training),
-            [params["encoder"][str(i)] for i in range(cfg.num_layers)],
+            enc_params,
             x, num_microbatches=M, layer_keys=layer_keys,
             extras=extras, extras_spec=extras_spec)
 

@@ -145,6 +145,69 @@ class Layer:
         return dict(self._sublayers)
 
 
+class StackedLayers(Layer):
+    """L structurally-identical layers stored as STACKED (L, ...) leaves —
+    the scan-over-layers layout.
+
+    TPU rationale: a transformer stack as L separate param subtrees makes
+    XLA compile L copies of the block and, under pipeline parallelism,
+    forces an in-graph stack + reshard every step. Stacked-from-init
+    leaves (a) scan-compile the block once, (b) carry a leading dim that
+    shards over "pp" natively (pipeline stages own their rows from
+    placement, no resharding), and (c) are what gpipe consumes directly.
+
+    The param tree has the TEMPLATE's structure with every leaf gaining a
+    leading (L,) dim; sharding hints get the stage axis prepended.
+    """
+
+    def __init__(self, template: "Layer", num_layers: int,
+                 stage_axis: str = "pp"):
+        super().__init__()
+        self.template = template
+        self.num_layers = num_layers
+        self.stage_axis = stage_axis
+
+    def init(self, key):
+        self._assign_paths(self._path)
+        per = [self.template.init(k)
+               for k in jax.random.split(key, self.num_layers)]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per)
+
+    def param_specs(self):
+        # template params live AT this module's path (no extra level);
+        # shapes gain (L,) and shardings the stage axis
+        self._assign_paths(self._path)
+        self.template._assign_paths(self._path)
+        out = {}
+        for path, spec in self.template.param_specs().items():
+            base = spec.sharding
+            if base is None:
+                sharding = jax.sharding.PartitionSpec(self.stage_axis)
+            else:
+                sharding = jax.sharding.PartitionSpec(self.stage_axis,
+                                                      *tuple(base))
+            out[path] = ParamSpec(
+                (self.num_layers,) + tuple(spec.shape), spec.dtype,
+                spec.initializer, spec.trainable, sharding)
+        return out
+
+    def forward(self, params, x, *, layer_keys=None, **kwargs):
+        """Sequential application via lax.scan (one compiled block)."""
+
+        def body(h, xs):
+            lp, k = xs
+            return self.template(lp, h, key=k, **kwargs), None
+
+        if layer_keys is None:
+            def body_nokey(h, lp):
+                return self.template(lp, h, **kwargs), None
+
+            h, _ = jax.lax.scan(body_nokey, x, params)
+            return h
+        h, _ = jax.lax.scan(body, x, (params, layer_keys))
+        return h
+
+
 class LayerList(Layer):
     """Indexable list of sublayers (fluid dygraph LayerList parity)."""
 

@@ -155,16 +155,20 @@ def gpipe_layer_stack(
     microbatching, and the reshape back.
 
     ``apply_layer(layer_params, h, extra, key) -> h``; ``params_list`` is
-    the per-layer param dicts in order; ``x``: (B, ...) activations;
-    ``extras``: optional (M, ...) per-microbatch side inputs (microbatch
-    them before calling). Used by BERT and GPT's pipeline paths.
+    the per-layer param dicts in order — or an ALREADY-STACKED pytree
+    with (L, ...) leaves (the nn.module.StackedLayers layout, which is
+    pp-sharded from init and skips the in-graph stack + reshard);
+    ``x``: (B, ...) activations; ``extras``: optional (M, ...)
+    per-microbatch side inputs (microbatch them before calling). Used by
+    BERT and GPT's pipeline paths.
     """
     M = num_microbatches
     b = x.shape[0]
     if b % M:
         raise ValueError(f"batch {b} not divisible by "
                          f"pp_microbatches={M}")
-    stacked = stack_layer_params(list(params_list))
+    stacked = (stack_layer_params(list(params_list))
+               if isinstance(params_list, (list, tuple)) else params_list)
     has_keys = layer_keys is not None and layer_keys[0] is not None
     if has_keys:
         stacked = (stacked, jnp.stack(list(layer_keys)))
