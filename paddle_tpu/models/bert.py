@@ -82,18 +82,16 @@ def stack_encoder_params(params, num_layers: int):
     """Convert a LayerList-layout BERT param tree ("encoder"/"0"/... per
     layer) to the stacked scan-over-layers layout — for loading
     checkpoints saved before ``stacked_layers`` (or by non-stacked
-    configs) into a stacked model."""
-    enc = [params["bert"]["encoder"][str(i)] for i in range(num_layers)]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *enc)
-    return dict(params, bert=dict(params["bert"], encoder=stacked))
+    configs) into a stacked model. (Generic form for other models:
+    parallel.pipeline.stack_params_at.)"""
+    from paddle_tpu.parallel.pipeline import stack_params_at
+    return stack_params_at(params, ("bert", "encoder"), num_layers)
 
 
 def unstack_encoder_params(params, num_layers: int):
     """Inverse of :func:`stack_encoder_params`."""
-    enc = {str(i): jax.tree_util.tree_map(lambda x: x[i],
-                                          params["bert"]["encoder"])
-           for i in range(num_layers)}
-    return dict(params, bert=dict(params["bert"], encoder=enc))
+    from paddle_tpu.parallel.pipeline import unstack_params_at
+    return unstack_params_at(params, ("bert", "encoder"), num_layers)
 
 
 class BertEmbeddings(Layer):

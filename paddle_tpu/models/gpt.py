@@ -9,13 +9,14 @@ workload for ring attention. Pre-LN, learned positions, tied head.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm
-from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.nn.module import Layer, LayerList, StackedLayers
 from paddle_tpu.nn.transformer import (ACT_SPEC, FeedForward,
                                        MultiHeadAttention, _constrain)
 
@@ -33,6 +34,15 @@ class GPTConfig:
     # GPipe the block stack over the "pp" mesh axis (parallel/pipeline.py)
     pipeline: bool = False
     pp_microbatches: int = 2
+    # stacked (L, ...) scan-over-layers param layout (see BertConfig);
+    # defaults on with pipeline. NOTE: changes the checkpoint tree —
+    # migrate older per-layer trees with
+    # parallel.pipeline.stack_params_at(params, ("blocks",), L).
+    stacked_layers: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.stacked_layers is None:
+            self.stacked_layers = self.pipeline
 
     @classmethod
     def tiny(cls, **kw):
@@ -78,8 +88,11 @@ class GPT(Layer):
         self.wpe = Embedding(cfg.max_position, cfg.hidden_size,
                              weight_init=I.normal(0.0, 0.01), sharding=None)
         self.drop = Dropout(cfg.dropout)
-        self.blocks = LayerList([GPTBlock(cfg)
-                                 for _ in range(cfg.num_layers)])
+        if cfg.stacked_layers:
+            self.blocks = StackedLayers(GPTBlock(cfg), cfg.num_layers)
+        else:
+            self.blocks = LayerList([GPTBlock(cfg)
+                                     for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size)
 
     def forward(self, params, ids, *, key=None, training=False):
@@ -93,6 +106,10 @@ class GPT(Layer):
         x = _constrain(x, ACT_SPEC)
         if cfg.pipeline:
             x = self._blocks_pipelined(params, x, keys[1:], training)
+        elif cfg.stacked_layers:
+            lkeys = (jnp.stack(keys[1:]) if keys[1] is not None else None)
+            x = self.blocks(params["blocks"], x, layer_keys=lkeys,
+                            training=training)
         else:
             for i, block in enumerate(self.blocks):
                 x = block(params["blocks"][str(i)], x, key=keys[i + 1],
@@ -107,12 +124,17 @@ class GPT(Layer):
         from paddle_tpu.parallel import pipeline as pp_lib
 
         cfg = self.cfg
-        block0 = self.blocks[0]
+        if cfg.stacked_layers:
+            block0 = self.blocks.template
+            blk_params = params["blocks"]        # pre-stacked (L, ...)
+        else:
+            block0 = self.blocks[0]
+            blk_params = [params["blocks"][str(i)]
+                          for i in range(cfg.num_layers)]
         return pp_lib.gpipe_layer_stack(
             lambda lp, h, extra, k: block0(lp, h, key=k,
                                            training=training),
-            [params["blocks"][str(i)] for i in range(cfg.num_layers)],
-            x, num_microbatches=cfg.pp_microbatches,
+            blk_params, x, num_microbatches=cfg.pp_microbatches,
             layer_keys=layer_keys)
 
     def loss(self, params, ids, *, key=None, training=True):

@@ -168,10 +168,14 @@ class StackedLayers(Layer):
         self.stage_axis = stage_axis
 
     def init(self, key):
+        # local import: parallel.pipeline owns the one stacking idiom
+        # (module.py must stay importable before the parallel package)
+        from paddle_tpu.parallel.pipeline import stack_layer_params
+
         self._assign_paths(self._path)
-        per = [self.template.init(k)
-               for k in jax.random.split(key, self.num_layers)]
-        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per)
+        return stack_layer_params(
+            [self.template.init(k)
+             for k in jax.random.split(key, self.num_layers)])
 
     def param_specs(self):
         # template params live AT this module's path (no extra level);
@@ -191,8 +195,18 @@ class StackedLayers(Layer):
                 spec.initializer, spec.trainable, sharding)
         return out
 
-    def forward(self, params, x, *, layer_keys=None, **kwargs):
-        """Sequential application via lax.scan (one compiled block)."""
+    def forward(self, params, x, *, layer_keys=None, key=None, **kwargs):
+        """Sequential application via lax.scan (one compiled block).
+
+        Per-layer PRNG: pass stacked ``layer_keys`` (L keys), or a single
+        ``key`` which is split into L decorrelated per-layer keys (the
+        universal Layer ``key=`` convention — one key must never be
+        reused across layers or every layer draws identical dropout
+        masks)."""
+        if key is not None:
+            if layer_keys is not None:
+                raise ValueError("pass layer_keys OR key, not both")
+            layer_keys = jax.random.split(key, self.num_layers)
 
         def body(h, xs):
             lp, k = xs

@@ -39,6 +39,36 @@ def stack_layer_params(params_list):
         lambda *xs: jnp.stack(xs), *params_list)
 
 
+def _get_at(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set_at(tree, path, value):
+    if not path:
+        return value
+    return {**tree, path[0]: _set_at(tree[path[0]], path[1:], value)}
+
+
+def stack_params_at(params, path, num_layers: int):
+    """Convert the LayerList-layout subtree at ``path`` (per-layer dicts
+    keyed "0".."L-1") into stacked (L, ...) leaves — checkpoint migration
+    into the StackedLayers layout. E.g. BERT: path=("bert", "encoder");
+    GPT: path=("blocks",)."""
+    node = _get_at(params, path)
+    stacked = stack_layer_params([node[str(i)] for i in range(num_layers)])
+    return _set_at(params, tuple(path), stacked)
+
+
+def unstack_params_at(params, path, num_layers: int):
+    """Inverse of :func:`stack_params_at`."""
+    node = _get_at(params, path)
+    per = {str(i): jax.tree_util.tree_map(lambda x: x[i], node)
+           for i in range(num_layers)}
+    return _set_at(params, tuple(path), per)
+
+
 def gpipe(
     block_fn: Callable,
     stacked_params: Any,

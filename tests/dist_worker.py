@@ -121,7 +121,12 @@ def main():
 
     monitor = None
     if args.mode == "stall":
-        monitor = fleet.HeartbeatMonitor(timeout_s=5.0, check_every_s=0.5,
+        # generous timeout: jit compile of the first step counts toward
+        # the first beat, and a loaded CI host can take many seconds to
+        # compile — a short timeout makes the monitor fire SPURIOUSLY
+        # before the peer's scheduled death (observed under a full-suite
+        # run saturating the machine)
+        monitor = fleet.HeartbeatMonitor(timeout_s=30.0, check_every_s=0.5,
                                          on_stall=on_stall,
                                          log_fn=lambda m: None)
 

@@ -338,7 +338,8 @@ class TestGPTPipelined:
                    dropout=0.0, attn_impl="xla")
         m_ref = GPT(GPTConfig.tiny(**cfg))
         m_pp = GPT(GPTConfig.tiny(**cfg, pipeline=True,
-                                  pp_microbatches=4))
+                                  pp_microbatches=4,
+                                  stacked_layers=False))
         params = m_ref.init(jax.random.PRNGKey(0))
         ids = jax.random.randint(jax.random.PRNGKey(1), (16, 17), 0, 64,
                                  jnp.int32)
@@ -354,6 +355,30 @@ class TestGPTPipelined:
                         jax.tree_util.tree_leaves(g_ref)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4, rtol=1e-3)
+
+    def test_gpt_stacked_pipeline_parity(self):
+        """GPT with natively-stacked blocks through the pipeline vs the
+        same stacked params run through the scan path."""
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+        from paddle_tpu.models.gpt import GPT, GPTConfig
+
+        cfg = dict(vocab_size=64, hidden_size=16, num_layers=4,
+                   num_heads=2, ffn_size=32, max_position=32,
+                   dropout=0.0, attn_impl="xla")
+        m_pp = GPT(GPTConfig.tiny(**cfg, pipeline=True,
+                                  pp_microbatches=4))
+        m_seq = GPT(GPTConfig.tiny(**cfg, stacked_layers=True))
+        assert m_pp.cfg.stacked_layers
+        params = m_pp.init(jax.random.PRNGKey(0))
+        assert params["blocks"]["attn"]["qkv_proj"]["weight"].shape[0] == 4
+        ids = jax.random.randint(jax.random.PRNGKey(1), (16, 17), 0, 64,
+                                 jnp.int32)
+        l_seq = float(m_seq.loss(params, ids, training=False)[0])
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, pp=2))
+        with mesh_context(mesh):
+            l_pp = float(jax.jit(
+                lambda p: m_pp.loss(p, ids, training=False)[0])(params))
+        assert l_pp == pytest.approx(l_seq, rel=1e-5)
 
     def test_gpt_pp_trains_with_dropout(self):
         from paddle_tpu import optimizer as opt
