@@ -36,6 +36,11 @@ def _open_maybe_gz(path):
     return open(path, "rb")
 
 
+def _open_text(path):
+    import io
+    return io.TextIOWrapper(_open_maybe_gz(path), errors="ignore")
+
+
 def _find(data_dir, names):
     for n in names:
         for cand in (n, n + ".gz"):
@@ -280,5 +285,99 @@ def synthetic_ctr(n=2048, num_sparse_fields=26, num_dense=13,
             logit = dense @ dense_w / 4 + ((ids % 7 == 0) * field_w).sum()
             label = np.int64(1 / (1 + np.exp(-logit)) > r.rand())
             yield dense, ids, label
+
+    return reader
+
+
+def uci_housing(data_dir=None, split="train", *, test_fraction=0.2):
+    """UCI housing (python/paddle/dataset/uci_housing.py): 13 features +
+    target, whitespace-separated ``housing.data``. Features are
+    feature-normalized like the reference; deterministic train/test split.
+    With ``data_dir=None`` falls back to a synthetic linear dataset with
+    the same schema (sandbox default)."""
+    if data_dir is not None:
+        path = _find(data_dir, ["housing.data", "housing.data.gz"])
+        with _open_maybe_gz(path) as f:
+            rows = np.array([[float(v) for v in line.split()]
+                             for line in f if line.strip()],
+                            dtype=np.float32)
+    else:
+        rng = np.random.RandomState(0)
+        x = rng.randn(506, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        y = x @ w + 0.1 * rng.randn(506).astype(np.float32)
+        rows = np.concatenate([x, y[:, None]], axis=1)
+    feats = rows[:, :13]
+    mean, std = feats.mean(0), feats.std(0) + 1e-8
+    feats = (feats - mean) / std
+    n_test = int(len(rows) * test_fraction)
+    if split == "test":
+        sel = slice(len(rows) - n_test, None)
+    else:
+        sel = slice(0, len(rows) - n_test)
+    feats, target = feats[sel], rows[sel, 13]
+
+    def reader():
+        for i in range(len(feats)):
+            yield feats[i], np.float32(target[i])
+
+    return reader
+
+
+def movielens(data_dir=None, split="train", *, test_fraction=0.1, n=4096):
+    """MovieLens-1M (python/paddle/dataset/movielens.py): yields the
+    recommender-system book schema (user_id, gender, age_bucket,
+    occupation, movie_id, category_multihot[18], rating). Reads the
+    ml-1m ``::``-separated .dat files; ``data_dir=None`` -> synthetic
+    preference structure with the same schema."""
+    n_cat = 18
+    if data_dir is not None:
+        upath = _find(data_dir, ["users.dat"])
+        mpath = _find(data_dir, ["movies.dat"])
+        rpath = _find(data_dir, ["ratings.dat"])
+        users = {}
+        with _open_text(upath) as f:
+            for line in f:
+                uid, gender, age, occ, _ = line.strip().split("::")
+                ages = [1, 18, 25, 35, 45, 50, 56]
+                users[int(uid)] = (int(gender == "F"),
+                                  ages.index(int(age)), int(occ))
+        cats = {}
+        movies = {}
+        with _open_text(mpath) as f:
+            for line in f:
+                mid, _, genres = line.strip().split("::")
+                hot = np.zeros(n_cat, np.float32)
+                for g in genres.split("|"):
+                    hot[cats.setdefault(g, len(cats)) % n_cat] = 1.0
+                movies[int(mid)] = hot
+        ratings = []
+        with _open_text(rpath) as f:
+            for line in f:
+                uid, mid, rating, _ = line.strip().split("::")
+                ratings.append((int(uid), int(mid), float(rating)))
+    else:
+        rng = np.random.RandomState(0)
+        users = {u: (int(rng.rand() < 0.5), rng.randint(0, 7),
+                     rng.randint(0, 21)) for u in range(1, 101)}
+        movies = {m: (rng.rand(n_cat) < 0.15).astype(np.float32)
+                  for m in range(1, 201)}
+        taste = {u: rng.randn(n_cat) for u in users}
+        ratings = []
+        for _ in range(n):
+            u = rng.randint(1, 101)
+            m = rng.randint(1, 201)
+            score = 3.0 + taste[u] @ movies[m] + 0.3 * rng.randn()
+            ratings.append((u, m, float(np.clip(np.round(score), 1, 5))))
+    n_test = max(1, int(len(ratings) * test_fraction))
+    sel = ratings[-n_test:] if split == "test" else ratings[:-n_test]
+
+    def reader():
+        for uid, mid, rating in sel:
+            g, a, o = users.get(uid, (0, 0, 0))
+            cat = movies.get(mid, np.zeros(n_cat, np.float32))
+            yield (np.int64(uid), np.int64(g), np.int64(a), np.int64(o),
+                   np.int64(mid), cat.astype(np.float32),
+                   np.float32(rating))
 
     return reader

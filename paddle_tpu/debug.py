@@ -49,3 +49,50 @@ def finite_or_zero(x):
     """Scrub non-finite values (grad-scrubbing util for AMP overflow
     handling — the reference's loss-scaling path skips steps instead)."""
     return jnp.where(jnp.isfinite(x), x, 0.0)
+
+
+def print_program(fn, *example_args, stage="jaxpr", **example_kwargs):
+    """Program pretty-printer (``debugger.py`` ``draw_block_graphviz`` /
+    program printer parity). The "Program IR" of this framework is the
+    traced computation: ``stage="jaxpr"`` prints the closed jaxpr (op-level
+    view ≙ ProgramDesc blocks/ops), ``stage="hlo"`` the optimized-ready
+    StableHLO text XLA compiles (graph-IR view ≙ ir::Graph dumps).
+    Returns the string (and prints it)."""
+    import jax
+
+    if stage == "jaxpr":
+        text = str(jax.make_jaxpr(fn)(*example_args, **example_kwargs))
+    elif stage == "hlo":
+        text = jax.jit(fn).lower(
+            *example_args, **example_kwargs).as_text()
+    else:
+        raise ValueError(f"stage must be 'jaxpr' or 'hlo', got {stage!r}")
+    print(text)
+    return text
+
+
+def program_to_dot(fn, *example_args, max_nodes=200, **example_kwargs):
+    """Graphviz dot of the traced program (``net_drawer.py`` /
+    ``graph_viz_pass.cc`` parity): one node per jaxpr equation, edges along
+    var def->use. Returns the dot source string."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs).jaxpr
+    lines = ["digraph program {", "  rankdir=TB;",
+             "  node [shape=box, fontsize=10];"]
+    producers = {}
+    for i, eqn in enumerate(jaxpr.eqns[:max_nodes]):
+        label = eqn.primitive.name
+        lines.append(f'  op{i} [label="{label}"];')
+        for v in eqn.outvars:
+            producers[str(v)] = i
+    for i, eqn in enumerate(jaxpr.eqns[:max_nodes]):
+        for v in eqn.invars:
+            src = producers.get(str(v))
+            if src is not None and src != i:
+                lines.append(f"  op{src} -> op{i};")
+    if len(jaxpr.eqns) > max_nodes:
+        lines.append(f'  trunc [label="... {len(jaxpr.eqns) - max_nodes} '
+                     f'more ops", style=dashed];')
+    lines.append("}")
+    return "\n".join(lines)

@@ -174,3 +174,178 @@ class PrecisionRecall(Metric):
         r = self.tp / max(self.tp + self.fn, 1e-12)
         f1 = 2 * p * r / max(p + r, 1e-12)
         return {"precision": p, "recall": r, "f1": f1}
+
+
+class EditDistance(Metric):
+    """Streaming mean edit distance (metrics.EditDistance +
+    ``edit_distance_op.cc``): Levenshtein distance between predicted and
+    reference token sequences, optionally normalized by reference length.
+    Also tracks the sequence error rate (fraction with distance > 0)."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self._dist = 0.0
+        self._wrong = 0
+        self._n = 0
+
+    @staticmethod
+    def levenshtein(a, b) -> int:
+        a = list(np.asarray(a).reshape(-1))
+        b = list(np.asarray(b).reshape(-1))
+        if not a:
+            return len(b)
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    def update(self, hyps, refs, hyp_lengths=None, ref_lengths=None):
+        for i, (h, r) in enumerate(zip(hyps, refs)):
+            h = np.asarray(h)
+            r = np.asarray(r)
+            if hyp_lengths is not None:
+                h = h[:int(hyp_lengths[i])]
+            if ref_lengths is not None:
+                r = r[:int(ref_lengths[i])]
+            d = self.levenshtein(h, r)
+            if self.normalized:
+                d = d / max(len(r), 1)
+            self._dist += d
+            self._wrong += int(d > 0)
+            self._n += 1
+        return self
+
+    def eval(self):
+        n = max(self._n, 1)
+        return {"edit_distance": self._dist / n,
+                "instance_error": self._wrong / n}
+
+
+class DetectionMAP(Metric):
+    """Mean average precision over detection outputs
+    (``operators/detection/detection_map_op.cc`` + metrics.DetectionMAP).
+    Streaming: per image feed predicted (boxes, scores, classes) with a
+    validity mask (the static-shape NMS outputs) and padded ground truths;
+    AP is computed at eval() per class, '11point' or 'integral'."""
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_version: str = "11point",
+                 evaluate_difficult: bool = False):
+        if ap_version not in ("11point", "integral"):
+            raise ValueError(f"unknown ap_version {ap_version!r}")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, tp) over all images + total gt count
+        self._records = {}
+        self._gt_count = {}
+
+    def update(self, pred_boxes, pred_scores, pred_classes, pred_valid,
+               gt_boxes, gt_classes, gt_mask, gt_difficult=None):
+        """One image. pred_* (K, ...) with bool ``pred_valid``; gt_* (G,
+        ...) with bool ``gt_mask``; ``gt_difficult`` (G,) marks boxes
+        excluded from the positive count (VOC protocol)."""
+        from paddle_tpu.ops.detection import box_iou
+        import jax.numpy as jnp
+
+        pv = np.asarray(pred_valid, bool)
+        pb = np.asarray(pred_boxes)[pv]
+        ps = np.asarray(pred_scores)[pv]
+        pc = np.asarray(pred_classes)[pv]
+        gm = np.asarray(gt_mask, bool)
+        gb = np.asarray(gt_boxes)[gm]
+        gc = np.asarray(gt_classes)[gm]
+        gd = (np.asarray(gt_difficult)[gm].astype(bool)
+              if gt_difficult is not None else np.zeros(len(gb), bool))
+
+        for cls in np.unique(gc):
+            n_easy = int((~gd[gc == cls]).sum()) if not \
+                self.evaluate_difficult else int((gc == cls).sum())
+            self._gt_count[int(cls)] = \
+                self._gt_count.get(int(cls), 0) + n_easy
+
+        iou = (np.asarray(box_iou(jnp.asarray(pb, jnp.float32),
+                                  jnp.asarray(gb, jnp.float32)))
+               if len(pb) and len(gb) else np.zeros((len(pb), len(gb))))
+        order = np.argsort(-ps)
+        taken = np.zeros(len(gb), bool)
+        for i in order:
+            cls = int(pc[i])
+            rec = self._records.setdefault(cls, [])
+            same = (gc == pc[i]) & ~taken
+            cand = np.where(same)[0]
+            if len(cand) and len(pb):
+                j = cand[np.argmax(iou[i, cand])]
+                if iou[i, j] >= self.overlap_threshold:
+                    taken[j] = True
+                    if gd[j] and not self.evaluate_difficult:
+                        continue        # difficult match: drop silently
+                    rec.append((float(ps[i]), 1))
+                    continue
+            rec.append((float(ps[i]), 0))
+        return self
+
+    def _ap(self, recs, n_gt):
+        if not recs or n_gt == 0:
+            return 0.0
+        recs = sorted(recs, reverse=True)
+        tp = np.cumsum([t for _, t in recs])
+        fp = np.cumsum([1 - t for _, t in recs])
+        recall = tp / n_gt
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_version == "11point":
+            ap = 0.0
+            for r in np.linspace(0, 1, 11):
+                mask = recall >= r
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            return float(ap)
+        # integral: sum precision deltas at each recall step
+        ap = 0.0
+        prev_r = 0.0
+        for p, r in zip(precision, recall):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def eval(self) -> float:
+        # average only over classes with ground-truth instances (VOC /
+        # reference detection_map convention): a hallucinated class must
+        # not add a whole zero AP term
+        classes = [c for c, n in self._gt_count.items() if n > 0]
+        if not classes:
+            return 0.0
+        aps = [self._ap(self._records.get(c, []), self._gt_count[c])
+               for c in classes]
+        return float(np.mean(aps))
+
+
+class CompositeMetric(Metric):
+    """Bundle of metrics updated together (fluid metrics.CompositeMetric)."""
+
+    def __init__(self, *metrics):
+        self._metrics = list(metrics)
+
+    def add_metric(self, m):
+        self._metrics.append(m)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+        return self
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

@@ -9,9 +9,16 @@ from paddle_tpu.models.deepfm import DeepFM
 from paddle_tpu.models.transformer import Transformer, TransformerConfig
 from paddle_tpu.models.gpt import GPT, GPTConfig
 from paddle_tpu.models.book import (LinearRegression, RNNLanguageModel,
-                                    SentimentLSTM, SkipGramNS, Word2Vec)
+                                    RecommenderSystem, SentimentLSTM,
+                                    SkipGramNS, Word2Vec)
+from paddle_tpu.models.mobilenet import MobileNetV1, MobileNetV2
+from paddle_tpu.models.vgg import VGG, VGG16
+from paddle_tpu.models.se_resnext import SEResNeXt, SEResNeXt50
+from paddle_tpu.models.ssd import SSD, SSDConfig
 
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
-           "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec"]
+           "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
+           "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
+           "SEResNeXt50", "SSD", "SSDConfig"]

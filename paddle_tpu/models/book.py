@@ -143,3 +143,46 @@ class RNNLanguageModel(Layer):
             loss = nll.mean()
             ppl = jnp.exp(loss)
         return loss, {"ppl": ppl}
+
+
+class RecommenderSystem(Layer):
+    """book/05.recommender_system (test_recommender_system.py): two-tower
+    personalized-rating model — user tower (id/gender/age/occupation
+    embeddings) and movie tower (id embedding + category multi-hot),
+    fused by cosine similarity scaled to the rating range, MSE loss."""
+
+    def __init__(self, n_users=6041, n_movies=3953, n_cat=18, dim=32):
+        super().__init__()
+        self.user_emb = Embedding(n_users, dim)
+        self.gender_emb = Embedding(2, dim // 2)
+        self.age_emb = Embedding(7, dim // 2)
+        self.occ_emb = Embedding(21, dim // 2)
+        self.user_fc = Linear(dim + 3 * (dim // 2), dim, sharding=None)
+        self.movie_emb = Embedding(n_movies, dim)
+        self.cat_fc = Linear(n_cat, dim // 2, sharding=None)
+        self.movie_fc = Linear(dim + dim // 2, dim, sharding=None)
+
+    def forward(self, params, user_id, gender, age, occupation, movie_id,
+                categories):
+        u = jnp.concatenate([
+            self.user_emb(params["user_emb"], user_id),
+            self.gender_emb(params["gender_emb"], gender),
+            self.age_emb(params["age_emb"], age),
+            self.occ_emb(params["occ_emb"], occupation)], -1)
+        u = jnp.tanh(self.user_fc(params["user_fc"], u))
+        m = jnp.concatenate([
+            self.movie_emb(params["movie_emb"], movie_id),
+            jnp.tanh(self.cat_fc(params["cat_fc"], categories))], -1)
+        m = jnp.tanh(self.movie_fc(params["movie_fc"], m))
+        cos = (u * m).sum(-1) / (
+            jnp.linalg.norm(u, axis=-1) * jnp.linalg.norm(m, axis=-1)
+            + 1e-8)
+        return 5.0 * cos                      # scale_op(5) in the book
+
+    def loss(self, params, user_id, gender, age, occupation, movie_id,
+             categories, rating, *, training=True, key=None):
+        del training, key
+        pred = self.forward(params, user_id, gender, age, occupation,
+                            movie_id, categories)
+        mse = jnp.mean((pred - rating) ** 2)
+        return mse, {"mae": jnp.mean(jnp.abs(pred - rating))}

@@ -15,7 +15,6 @@ import jax.numpy as jnp
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear, Pool2D
 from paddle_tpu.nn.module import Layer, LayerList
-from paddle_tpu.ops import nn as ops_nn
 
 
 class ConvBNLayer(Layer):
@@ -33,6 +32,8 @@ class ConvBNLayer(Layer):
         x = self.bn(params["bn"], x, training=training)
         if self.act == "relu":
             x = jax.nn.relu(x)
+        elif self.act == "relu6":
+            x = jnp.clip(x, 0.0, 6.0)
         return x
 
 
@@ -122,11 +123,9 @@ class ResNet(Layer):
         return self.fc(params["fc"], x)
 
     def loss(self, params, image, label, *, training=True):
-        logits = self.forward(params, image, training=training)
-        loss = ops_nn.softmax_with_cross_entropy(
-            logits, label[:, None]).mean()
-        acc = (logits.argmax(-1) == label).mean()
-        return loss, {"acc": acc}
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training), label)
 
 
 def ResNet50(num_classes=1000, **kw):

@@ -384,3 +384,438 @@ def roi_align(features, rois, *, output_size=(7, 7), spatial_scale=1.0,
         return samples.mean(axis=(1, 3))
 
     return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Training-side detection stack: matching, target assignment, losses.
+# Reference: operators/detection/{bipartite_match,target_assign,
+# mine_hard_examples}_op.cc, ssd_loss composition in
+# python/paddle/fluid/layers/detection.py (ssd_loss), yolov3_loss_op.cc,
+# sigmoid_focal_loss_op.cc, rpn_target_assign_op.cc,
+# generate_proposals_op.cc, distribute_fpn_proposals_op.cc,
+# collect_fpn_proposals_op.cc, polygon_box_transform_op.cc.
+# TPU design: everything static-shape; ground truths arrive padded with a
+# row mask (the LoD analog), dynamic counts ride validity masks, and the
+# sequential greedy pieces are lax loops with trip count = padded G (small).
+# ---------------------------------------------------------------------------
+
+
+@register_op("bipartite_match")
+def bipartite_match(dist, row_mask=None):
+    """Greedy bipartite matching (bipartite_match_op.cc). ``dist`` (G, P):
+    similarity of ground-truth rows vs prior columns; ``row_mask`` (G,)
+    marks real rows of a padded batch. Iteratively matches the globally
+    best (row, col) pair and retires both. Returns (match_indices (P,)
+    int32 — matched row per column, -1 if none; match_dist (P,))."""
+    g, p = dist.shape
+    if row_mask is not None:
+        dist = jnp.where(row_mask[:, None], dist, -1.0)
+
+    def body(_, carry):
+        d, col_to_row, col_dist = carry
+        idx = jnp.argmax(d)
+        r, c = idx // p, idx % p
+        best = d[r, c]
+        ok = best > 0.0
+        col_to_row = jnp.where(ok, col_to_row.at[c].set(r.astype(jnp.int32)),
+                               col_to_row)
+        col_dist = jnp.where(ok, col_dist.at[c].set(best), col_dist)
+        d2 = d.at[r, :].set(-1.0)
+        d2 = d2.at[:, c].set(-1.0)
+        return jnp.where(ok, d2, d), col_to_row, col_dist
+
+    init = (dist, jnp.full((p,), -1, jnp.int32),
+            jnp.zeros((p,), dist.dtype))
+    _, col_to_row, col_dist = jax.lax.fori_loop(0, g, body, init)
+    return col_to_row, col_dist
+
+
+def match_boxes(iou, row_mask=None, *, match_type="per_prediction",
+                overlap_threshold=0.5):
+    """SSD matching: bipartite seeds, then (per_prediction) every unmatched
+    prior whose best-IoU ground truth exceeds ``overlap_threshold`` also
+    matches it (layers/detection.py ssd_loss matching step)."""
+    m_idx, m_dist = bipartite_match(iou, row_mask)
+    if match_type == "per_prediction":
+        masked = iou if row_mask is None else jnp.where(
+            row_mask[:, None], iou, -1.0)
+        best_row = jnp.argmax(masked, axis=0).astype(jnp.int32)
+        best_iou = jnp.max(masked, axis=0)
+        extra = (m_idx < 0) & (best_iou >= overlap_threshold)
+        m_idx = jnp.where(extra, best_row, m_idx)
+        m_dist = jnp.where(extra, best_iou, m_dist)
+    return m_idx, m_dist
+
+
+@register_op("target_assign")
+def target_assign(x, match_indices, mismatch_value=0.0):
+    """Gather per-prior targets from per-ground-truth rows
+    (target_assign_op.cc). ``x`` (G, K) row attributes; ``match_indices``
+    (P,) from :func:`bipartite_match`. Returns (out (P, K), out_weight (P,)
+    — 1.0 where matched, 0.0 elsewhere; unmatched rows filled with
+    ``mismatch_value``)."""
+    matched = match_indices >= 0
+    out = x[jnp.maximum(match_indices, 0)]
+    out = jnp.where(matched[:, None], out,
+                    jnp.asarray(mismatch_value, x.dtype))
+    return out, matched.astype(jnp.float32)
+
+
+def topk_mask(mask, score, limit):
+    """Keep at most ``limit`` (dynamic) True entries of ``mask``, the ones
+    with the highest ``score`` — the static-shape "dynamic count as a rank
+    threshold" idiom shared by hard-negative mining and RPN subsampling."""
+    p = score.shape[0]
+    order = jnp.argsort(-jnp.where(mask, score, -jnp.inf))
+    rank = jnp.zeros((p,), jnp.int32).at[order].set(
+        jnp.arange(p, dtype=jnp.int32))
+    return mask & (rank < limit)
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(neg_loss, match_indices, *, neg_pos_ratio=3.0,
+                       sample_size=None):
+    """Hard-negative mining, ``max_negative`` mode
+    (mine_hard_examples_op.cc): keep the ``neg_pos_ratio * num_pos``
+    unmatched priors with the highest candidate loss. The dynamic count is
+    carried as a rank threshold (static shapes). Returns bool (P,)."""
+    p = neg_loss.shape[0]
+    pos = match_indices >= 0
+    num_pos = pos.sum()
+    cap = jnp.asarray(sample_size, jnp.int32) if sample_size is not None \
+        else jnp.asarray(p, jnp.int32)
+    num_neg = jnp.minimum((neg_pos_ratio * num_pos).astype(jnp.int32), cap)
+    return topk_mask(~pos & jnp.isfinite(neg_loss), neg_loss, num_neg)
+
+
+@register_op("ssd_loss")
+def ssd_loss(loc_pred, conf_pred, anchors, gt_boxes, gt_labels, gt_mask, *,
+             background_label=0, overlap_threshold=0.5, neg_pos_ratio=3.0,
+             loc_weight=1.0, conf_weight=1.0,
+             variances=(0.1, 0.1, 0.2, 0.2)):
+    """MultiBox SSD loss (layers/detection.py ssd_loss, composed from the
+    same primitive ops as the reference): match -> encode -> smooth-L1 on
+    positives + softmax CE on positives and mined hard negatives,
+    normalized by the matched count per image.
+
+    loc_pred (B, P, 4) deltas; conf_pred (B, P, C) logits (class 0 =
+    background); anchors (P, 4) normalized xyxy; gt_boxes (B, G, 4)
+    normalized xyxy (padded); gt_labels (B, G) int in [1, C); gt_mask
+    (B, G) bool. Returns scalar mean loss."""
+    from paddle_tpu.ops.nn import smooth_l1
+
+    def one(loc_p, conf_p, gt_b, gt_l, gt_m):
+        iou = box_iou(gt_b, anchors)                          # (G, P)
+        m_idx, _ = match_boxes(iou, gt_m,
+                               overlap_threshold=overlap_threshold)
+        pos = m_idx >= 0
+        tgt_boxes, _ = target_assign(gt_b, m_idx)
+        loc_t = box_encode(tgt_boxes, anchors, variances)
+        loc_l = (smooth_l1(loc_p, jax.lax.stop_gradient(loc_t)).sum(-1)
+                 * pos)                                       # (P,)
+        cls_t = jnp.where(pos, gt_l[jnp.maximum(m_idx, 0)],
+                          background_label)
+        logp = jax.nn.log_softmax(conf_p.astype(jnp.float32), -1)
+        ce = -jnp.take_along_axis(logp, cls_t[:, None], -1)[:, 0]
+        neg = mine_hard_examples(-logp[:, background_label], m_idx,
+                                 neg_pos_ratio=neg_pos_ratio)
+        conf_l = ce * (pos | neg)
+        n_match = jnp.maximum(pos.sum(), 1)
+        return (loc_weight * loc_l.sum()
+                + conf_weight * conf_l.sum()) / n_match
+
+    return jax.vmap(one)(loc_pred, conf_pred, gt_boxes, gt_labels,
+                         gt_mask).mean()
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logits, labels, *, gamma=2.0, alpha=0.25,
+                       normalizer=None):
+    """Focal loss (sigmoid_focal_loss_op.cc, RetinaNet). ``logits`` (N, C);
+    ``labels`` (N,) int in [0, C] where 0 = background and class k maps to
+    column k-1 (the reference convention). Returns the per-element (N, C)
+    loss, optionally divided by ``normalizer`` (foreground count)."""
+    n, c = logits.shape
+    t = (labels[:, None] == jnp.arange(1, c + 1)[None, :]).astype(
+        logits.dtype)
+    p = jax.nn.sigmoid(logits)
+    bce = (jnp.maximum(logits, 0.0) - logits * t
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    p_t = p * t + (1.0 - p) * (1.0 - t)
+    a_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+    loss = a_t * (1.0 - p_t) ** gamma * bce
+    if normalizer is not None:
+        loss = loss / jnp.maximum(normalizer, 1.0)
+    return loss
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(x, gt_boxes, gt_labels, gt_mask, *, anchors, anchor_mask,
+                class_num, ignore_thresh=0.7, downsample_ratio=32):
+    """YOLOv3 loss for one head (yolov3_loss_op.cc). ``x`` (B, A*(5+C), H,
+    W) NCHW raw head output, A = len(anchor_mask); ``anchors`` the FULL
+    pixel-space anchor list [(w, h), ...]; ``anchor_mask`` the indices this
+    head owns; ``gt_boxes`` (B, G, 4) normalized (cx, cy, w, h) in [0, 1]
+    (the reference layout); ``gt_labels`` (B, G) int; ``gt_mask`` (B, G).
+
+    Per ground truth: the responsible cell is (floor(cx*W), floor(cy*H));
+    the responsible anchor is the best wh-IoU over the FULL anchor set —
+    the gt contributes xywh/obj/class terms only if that anchor belongs to
+    this head. Objectness negatives are cells whose best predicted-box IoU
+    with any gt stays below ``ignore_thresh``. Returns scalar mean loss."""
+    b, _, h, w = x.shape
+    a = len(anchor_mask)
+    c = class_num
+    g = gt_boxes.shape[1]
+    full = jnp.asarray(anchors, jnp.float32)                  # (Af, 2)
+    own = jnp.asarray(anchor_mask, jnp.int32)                 # (A,)
+    head_wh = full[own]                                       # (A, 2)
+    in_w = w * downsample_ratio
+    in_h = h * downsample_ratio
+
+    x = x.reshape(b, a, 5 + c, h, w).transpose(0, 3, 4, 1, 2)  # (B,H,W,A,5+C)
+
+    def wh_iou(wh1, wh2):
+        inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * \
+            jnp.minimum(wh1[..., 1], wh2[..., 1])
+        return inter / jnp.maximum(
+            wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter,
+            1e-10)
+
+    def one(head, gt_b, gt_l, gt_m):
+        # --- decode predicted boxes (normalized cxcywh) for ignore mask
+        grid_x = jnp.arange(w, dtype=jnp.float32)[None, :, None]
+        grid_y = jnp.arange(h, dtype=jnp.float32)[:, None, None]
+        px = (jax.nn.sigmoid(head[..., 0]) + grid_x) / w
+        py = (jax.nn.sigmoid(head[..., 1]) + grid_y) / h
+        pw = jnp.exp(jnp.clip(head[..., 2], -10, 10)) * \
+            head_wh[None, None, :, 0] / in_w
+        ph = jnp.exp(jnp.clip(head[..., 3], -10, 10)) * \
+            head_wh[None, None, :, 1] / in_h
+        pred = jnp.stack([px - pw / 2, py - ph / 2,
+                          px + pw / 2, py + ph / 2], -1)      # (H,W,A,4)
+        gt_xyxy = jnp.concatenate([gt_b[:, :2] - gt_b[:, 2:] / 2,
+                                   gt_b[:, :2] + gt_b[:, 2:] / 2], -1)
+        ious = box_iou(pred.reshape(-1, 4), gt_xyxy)          # (HWA, G)
+        ious = jnp.where(gt_m[None, :], ious, 0.0)
+        ignore = (ious.max(-1) >= ignore_thresh).reshape(h, w, a)
+
+        # --- per-gt responsible (cell, anchor) targets, scattered
+        t_obj = jnp.zeros((h, w, a))
+        t_xy = jnp.zeros((h, w, a, 2))
+        t_wh = jnp.zeros((h, w, a, 2))
+        t_cls = jnp.zeros((h, w, a, c))
+        t_scale = jnp.zeros((h, w, a))
+
+        def assign(i, carry):
+            t_obj, t_xy, t_wh, t_cls, t_scale = carry
+            box = gt_b[i]
+            gi = jnp.clip((box[0] * w).astype(jnp.int32), 0, w - 1)
+            gj = jnp.clip((box[1] * h).astype(jnp.int32), 0, h - 1)
+            gt_wh_pix = box[2:] * jnp.asarray([in_w, in_h], jnp.float32)
+            best = jnp.argmax(wh_iou(full, gt_wh_pix[None, :]))
+            owned = (own == best)
+            ai = jnp.argmax(owned)                            # head slot
+            use = gt_m[i] & owned.any() & (box[2] > 0) & (box[3] > 0)
+            tx = box[0] * w - gi
+            ty = box[1] * h - gj
+            twh = jnp.log(jnp.maximum(
+                gt_wh_pix / jnp.maximum(full[best], 1e-10), 1e-10))
+            scale = 2.0 - box[2] * box[3]
+            onehot = jax.nn.one_hot(gt_l[i], c)
+            t_obj = jnp.where(use, t_obj.at[gj, gi, ai].set(1.0), t_obj)
+            t_xy = jnp.where(use, t_xy.at[gj, gi, ai].set(
+                jnp.stack([tx, ty])), t_xy)
+            t_wh = jnp.where(use, t_wh.at[gj, gi, ai].set(twh), t_wh)
+            t_cls = jnp.where(use, t_cls.at[gj, gi, ai].set(onehot), t_cls)
+            t_scale = jnp.where(use, t_scale.at[gj, gi, ai].set(scale),
+                                t_scale)
+            return t_obj, t_xy, t_wh, t_cls, t_scale
+
+        t_obj, t_xy, t_wh, t_cls, t_scale = jax.lax.fori_loop(
+            0, g, assign, (t_obj, t_xy, t_wh, t_cls, t_scale))
+
+        def bce(logit, target):
+            return (jnp.maximum(logit, 0.0) - logit * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        pos = t_obj > 0
+        sc = t_scale * pos
+        loss_xy = (bce(head[..., 0:2], t_xy).sum(-1) * sc).sum()
+        loss_wh = (jnp.abs(head[..., 2:4] - t_wh).sum(-1) * sc).sum()
+        obj_logit = head[..., 4]
+        loss_obj = (bce(obj_logit, 1.0) * pos).sum() + \
+            (bce(obj_logit, 0.0) * (~pos & ~ignore)).sum()
+        loss_cls = (bce(head[..., 5:], t_cls).sum(-1) * pos).sum()
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return jax.vmap(one)(x, gt_boxes, gt_labels, gt_mask).mean()
+
+
+@register_op("rpn_target_assign")
+def rpn_target_assign(anchors, gt_boxes, gt_mask, *, im_shape=None,
+                      pos_threshold=0.7, neg_threshold=0.3,
+                      batch_size_per_im=256, fg_fraction=0.5,
+                      variances=(1.0, 1.0, 1.0, 1.0), key=None):
+    """RPN anchor labeling (rpn_target_assign_op.cc): label 1 for anchors
+    with IoU >= pos_threshold or each gt's argmax anchor; 0 below
+    neg_threshold; -1 (ignored) between. Counts are capped at
+    ``fg_fraction * batch_size_per_im`` foregrounds and the remainder
+    backgrounds — the reference subsamples randomly; pass ``key`` for that,
+    otherwise the hardest (highest/lowest IoU) are kept deterministically.
+    Returns (labels (P,) int32, bbox_targets (P, 4), pos_mask, neg_mask)."""
+    p = anchors.shape[0]
+    iou = box_iou(gt_boxes, anchors)                          # (G, P)
+    iou = jnp.where(gt_mask[:, None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=0)                         # per anchor
+    best_iou = jnp.max(iou, axis=0)
+    # each gt's best anchor is always fg (ties broadcast via equality) —
+    # but only when the gt overlaps SOMETHING: a zero-IoU gt must not
+    # force every anchor positive through the >= 0 comparison
+    gt_best = jnp.max(jnp.where(gt_mask[:, None], iou, -jnp.inf), axis=1)
+    forced = ((iou >= gt_best[:, None]) & gt_mask[:, None]
+              & (gt_best[:, None] > 0)).any(0)
+    fg = forced | (best_iou >= pos_threshold)
+    # best_iou == -1 (no valid gt at all) is definitionally background:
+    # empty images must still contribute negative objectness samples
+    bg = (~fg) & (best_iou < neg_threshold)
+
+    max_fg = int(batch_size_per_im * fg_fraction)
+    rand = (jax.random.uniform(key, (p,)) if key is not None
+            else jnp.zeros((p,)))
+
+    fg = topk_mask(fg, best_iou + rand, max_fg)
+    n_fg = fg.sum()
+    bg = topk_mask(bg, -best_iou + rand, batch_size_per_im - n_fg)
+
+    labels = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+    tgt = box_encode(gt_boxes[best_gt], anchors, variances)
+    tgt = jnp.where(fg[:, None], tgt, 0.0)
+    return labels, tgt, fg, bg
+
+
+@register_op("generate_proposals")
+def generate_proposals(scores, deltas, anchors, im_shape, *,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.7, min_size=0.0,
+                       variances=(1.0, 1.0, 1.0, 1.0)):
+    """RPN proposal generation (generate_proposals_op.cc), one image:
+    decode -> clip -> drop tiny -> top-k pre-NMS -> NMS -> top-k post.
+    ``scores`` (P,), ``deltas`` (P, 4), ``anchors`` (P, 4) pixel xyxy,
+    ``im_shape`` (2,) = (h, w). Returns (rois (post, 4), roi_scores
+    (post,), valid (post,) bool) — static shapes."""
+    p = scores.shape[0]
+    boxes = box_decode(deltas, anchors, variances)
+    boxes = box_clip(boxes, im_shape)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    keep = (ws >= min_size) & (hs >= min_size)
+    s = jnp.where(keep, scores, -jnp.inf)
+    k = min(pre_nms_top_n, p)
+    top_s, order = jax.lax.top_k(s, k)
+    cand = boxes[order]
+    idxs, valid = nms(cand, top_s, iou_threshold=nms_thresh,
+                      score_threshold=-jnp.inf,
+                      max_outputs=min(post_nms_top_n, k))
+    rois = cand[idxs]
+    roi_scores = jnp.where(valid, top_s[idxs], -jnp.inf)
+    valid = valid & jnp.isfinite(roi_scores)
+    pad = post_nms_top_n - idxs.shape[0]
+    if pad > 0:
+        rois = jnp.concatenate([rois, jnp.zeros((pad, 4))])
+        roi_scores = jnp.concatenate(
+            [roi_scores, jnp.full((pad,), -jnp.inf)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+    return rois, jnp.where(valid, roi_scores, 0.0), valid
+
+
+@register_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(rois, *, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """Map RoIs to FPN levels (distribute_fpn_proposals_op.cc):
+    level = clip(floor(refer_level + log2(sqrt(area)/refer_scale))).
+    The reference splits into per-level LoD tensors; here the split is a
+    (L, N) bool mask stack plus the level index per RoI — downstream heads
+    run all levels with masked RoIs (static shapes)."""
+    ws = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    hs = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(refer_level + jnp.log2(
+        jnp.maximum(scale, 1e-6) / refer_scale))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    levels = jnp.arange(min_level, max_level + 1)
+    masks = lvl[None, :] == levels[:, None]                   # (L, N)
+    return lvl, masks
+
+
+@register_op("collect_fpn_proposals")
+def collect_fpn_proposals(rois_list, scores_list, valid_list=None, *,
+                          post_nms_top_n=1000):
+    """Merge per-level proposals and keep the global top-k by score
+    (collect_fpn_proposals_op.cc). Inputs: lists of (Ni, 4) / (Ni,);
+    ``valid_list`` carries :func:`generate_proposals`' validity masks so
+    its zero-padded entries never outrank real proposals.
+    Returns (rois (k, 4), scores (k,), valid (k,))."""
+    rois = jnp.concatenate(rois_list, axis=0)
+    scores = jnp.concatenate(scores_list, axis=0)
+    if valid_list is not None:
+        scores = jnp.where(jnp.concatenate(valid_list, axis=0),
+                           scores, -jnp.inf)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, order = jax.lax.top_k(scores, k)
+    out_r = rois[order]
+    valid = jnp.isfinite(top_s)
+    pad = post_nms_top_n - k
+    if pad > 0:
+        out_r = jnp.concatenate([out_r, jnp.zeros((pad, 4))])
+        top_s = jnp.concatenate([top_s, jnp.full((pad,), -jnp.inf)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+    return out_r, jnp.where(valid, top_s, 0.0), valid
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(x):
+    """EAST quad-offset to absolute coords (polygon_box_transform_op.cc):
+    input (B, 8, H, W) predicted offsets on a 4x-downsampled grid; output
+    channel 2k   (x offsets): 4*w_index - in,
+    channel 2k+1 (y offsets): 4*h_index - in."""
+    b, c, h, w = x.shape
+    xi = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    yi = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return jnp.where(is_x, xi - x, yi - x)
+
+
+@register_op("retinanet_detection_output")
+def retinanet_detection_output(boxes_list, scores_list, anchors_list,
+                               im_shape, *, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.5,
+                               variances=(1.0, 1.0, 1.0, 1.0)):
+    """RetinaNet decode + multiclass NMS across FPN levels
+    (retinanet_detection_output_op.cc), one image. ``boxes_list``: per-level
+    (Pi, 4) deltas; ``scores_list``: per-level (Pi, C) sigmoid scores;
+    ``anchors_list``: per-level (Pi, 4). Returns (boxes (K, 4), cls (K,),
+    scores (K,), valid (K,)) with K = keep_top_k."""
+    decoded = [box_clip(box_decode(d, a, variances), im_shape)
+               for d, a in zip(boxes_list, anchors_list)]
+    boxes = jnp.concatenate(decoded, axis=0)
+    scores = jnp.concatenate(scores_list, axis=0)             # (P, C)
+    # pre-NMS top-k by best class score (the reference filters per level
+    # before NMS): bounds the NxN IoU matrix at nms_top_k, not P
+    k = min(nms_top_k, scores.shape[0])
+    _, sel = jax.lax.top_k(scores.max(axis=1), k)
+    boxes = boxes[sel]
+    scores = scores[sel]
+    c = scores.shape[1]
+    per = max(1, keep_top_k)
+    cls_ids, idxs, valid = multiclass_nms(
+        boxes, scores, iou_threshold=nms_threshold,
+        score_threshold=score_threshold, max_per_class=per)
+    sel_scores = jnp.where(
+        valid, scores[idxs, cls_ids], -jnp.inf)
+    k = min(keep_top_k, sel_scores.shape[0])
+    top_s, order = jax.lax.top_k(sel_scores, k)
+    out_valid = jnp.isfinite(top_s)
+    return (boxes[idxs[order]], cls_ids[order],
+            jnp.where(out_valid, top_s, 0.0), out_valid)

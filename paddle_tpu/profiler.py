@@ -36,7 +36,23 @@ def record_event(name: str):
     with jax.named_scope(name):
         yield
     if _EVENTS.active is not None:
-        _EVENTS.active.append((name, time.perf_counter() - t0))
+        _EVENTS.active.append((name, time.perf_counter() - t0, t0))
+
+
+@contextlib.contextmanager
+def _collect_events(out: list):
+    """Install a fresh host-event buffer; restore the previous one and
+    append (events, wall) to ``out`` on exit. Shared by every profiling
+    context manager so the collection protocol lives in one place."""
+    prev = _EVENTS.active
+    _EVENTS.active = []
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        events = _EVENTS.active
+        _EVENTS.active = prev
+        out.append((events, time.perf_counter() - t0))
 
 
 @contextlib.contextmanager
@@ -45,19 +61,16 @@ def profiler(output_dir: Optional[str] = None, *, summary: bool = True):
     viewable in TensorBoard/XProf (device timeline ≙ CUPTI tracer + Chrome
     trace). Always collects host record_event stats; prints the sorted
     summary table on exit (EnableProfiler/DisableProfiler parity)."""
-    prev = _EVENTS.active
-    _EVENTS.active = []
     if output_dir:
         jax.profiler.start_trace(output_dir)
-    t0 = time.perf_counter()
+    res = []
     try:
-        yield
+        with _collect_events(res):
+            yield
     finally:
-        wall = time.perf_counter() - t0
         if output_dir:
             jax.profiler.stop_trace()
-        events = _EVENTS.active
-        _EVENTS.active = prev
+        events, wall = res[0]
         if summary and events:
             print(format_summary(events, wall))
 
@@ -65,7 +78,7 @@ def profiler(output_dir: Optional[str] = None, *, summary: bool = True):
 def format_summary(events, wall: float) -> str:
     """Sorted per-event table (profiler.cc sorted summaries)."""
     agg: Dict[str, List[float]] = {}
-    for name, dt in events:
+    for name, dt, *_ in events:
         agg.setdefault(name, []).append(dt)
     rows = sorted(agg.items(), key=lambda kv: -sum(kv[1]))
     lines = [f"{'Event':<32}{'Calls':>8}{'Total(s)':>12}{'Avg(ms)':>12}"
@@ -76,6 +89,43 @@ def format_summary(events, wall: float) -> str:
                      f"{1e3 * tot / len(ts):>12.3f}"
                      f"{tot / max(wall, 1e-9):>8.2%}")
     return "\n".join(lines)
+
+
+def chrome_trace(events, path: str, *, pid: int = 0):
+    """Write host events as a Chrome trace (``chrome://tracing`` /
+    Perfetto) — ``tools/timeline.py:131`` ``_ChromeTraceFormatter`` parity
+    for the host-side table. Device-side timelines come from the
+    jax.profiler capture (XPlane → Perfetto) which subsumes the CUPTI
+    path; this covers the reference's host-annotation stream."""
+    import json
+
+    if not events:
+        trace = {"traceEvents": []}
+    else:
+        base = min(t0 for _, _, t0 in events)
+        trace = {"traceEvents": [
+            {"name": name, "ph": "X", "pid": pid, "tid": 0,
+             "ts": (t0 - base) * 1e6, "dur": dt * 1e6,
+             "cat": "host"}
+            for name, dt, t0 in events]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextlib.contextmanager
+def profile_to_chrome_trace(path: str, *, summary: bool = False):
+    """Profile a region and dump the host event stream as a Chrome trace
+    file (fluid.profiler.profiler(output='timeline') parity)."""
+    res = []
+    try:
+        with _collect_events(res):
+            yield
+    finally:
+        events, wall = res[0]
+        chrome_trace(events, path)
+        if summary and events:
+            print(format_summary(events, wall))
 
 
 def start_server(port: int = 9012):

@@ -16,6 +16,7 @@ matmul shapes (structured sparsity in hardware is out of scope for v5e).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -301,3 +302,64 @@ def distill_loss_fn(student_loss_fn: Callable, teacher_fn: Callable, *,
         return total, dict(aux, hard_loss=hard, kd_loss=kd)
 
     return loss
+
+
+# ---------------------------------------------------------------------------
+# Quantization-aware training (contrib/slim/quantization
+# QuantizationTransformPass parity). The reference rewrites the program
+# graph inserting fake_quantize/dequantize ops before quantizable ops; here
+# the analogous transform wraps the loss function: weights are fake-quantized
+# (STE gradients, ops/quant.py) on the way into the forward pass, so
+# training observes int8 rounding while optimizer state stays fp32.
+# ---------------------------------------------------------------------------
+
+
+def _fake_quant_params(params, *, bit_length: int,
+                       predicate: Optional[Callable],
+                       channel_wise: bool):
+    """Shared walk: fake-quantize quantizable leaves (STE grads)."""
+    from paddle_tpu.ops import quant as Q
+
+    pred = predicate or _prunable
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if not pred(path, tree):
+            return tree
+        if channel_wise and tree.ndim >= 2:
+            return Q.fake_channel_wise_quantize_abs_max(
+                tree, bit_length=bit_length)[0]
+        return Q.fake_quantize_abs_max(tree, bit_length=bit_length)[0]
+
+    return walk(params)
+
+
+def qat_transform(loss_fn: Callable, *, bit_length: int = 8,
+                  predicate: Optional[Callable] = None,
+                  channel_wise: bool = False) -> Callable:
+    """Wrap ``loss_fn(params, **batch)`` so quantizable weights pass
+    through fake-quant (abs-max, STE) first. ``predicate(path, leaf)``
+    selects leaves (default: the same >=2-D weight rule as pruning)."""
+
+    @functools.wraps(loss_fn)
+    def wrapped(params, *args, **kwargs):
+        return loss_fn(
+            _fake_quant_params(params, bit_length=bit_length,
+                               predicate=predicate,
+                               channel_wise=channel_wise),
+            *args, **kwargs)
+
+    return wrapped
+
+
+def qat_convert(params, *, bit_length: int = 8,
+                predicate: Optional[Callable] = None,
+                channel_wise: bool = False):
+    """Freeze QAT training into deployment weights
+    (QuantizationFreezePass parity): snap quantizable leaves to the SAME
+    fake-quant grid training observed — pass the ``channel_wise`` used in
+    :func:`qat_transform`."""
+    return _fake_quant_params(params, bit_length=bit_length,
+                              predicate=predicate,
+                              channel_wise=channel_wise)

@@ -1,0 +1,214 @@
+"""Aux-subsystem tail: recommender book model + movielens/uci_housing
+loaders, chrome-trace export (tools/timeline.py parity), program printer
+(debugger.py parity), QAT transform (slim QuantizationTransformPass
+parity)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestRecommender:
+    def _batch(self, reader, n=64):
+        rows = []
+        for i, row in enumerate(reader()):
+            rows.append(row)
+            if i + 1 == n:
+                break
+        cols = list(zip(*rows))
+        return [jnp.asarray(np.stack(c)) for c in cols]
+
+    def test_trains_on_movielens_schema(self):
+        from paddle_tpu.data.datasets import movielens
+        from paddle_tpu.models.book import RecommenderSystem
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        model = RecommenderSystem(n_users=101, n_movies=201, dim=16)
+        uid, g, a, o, mid, cat, rating = self._batch(movielens())
+        batch = dict(user_id=uid, gender=g, age=a, occupation=o,
+                     movie_id=mid, categories=cat, rating=rating)
+        optimizer = opt.Adam(learning_rate=1e-2)
+        step = jax.jit(build_train_step(
+            lambda p, **b: model.loss(p, **b), optimizer))
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(8):
+            state, m = step(state, **batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_movielens_real_format(self, tmp_path):
+        (tmp_path / "users.dat").write_text(
+            "1::F::1::10::48067\n2::M::56::16::70072\n")
+        (tmp_path / "movies.dat").write_text(
+            "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+            "2::Jumanji (1995)::Adventure\n")
+        (tmp_path / "ratings.dat").write_text(
+            "1::1::5::978300760\n2::2::3::978299026\n"
+            "1::2::4::978301968\n2::1::1::978300275\n")
+        from paddle_tpu.data.datasets import movielens
+        rows = list(movielens(str(tmp_path), split="train")())
+        assert len(rows) == 3          # 10% (>=1) held out
+        uid, gender, age, occ, mid, cat, rating = rows[0]
+        assert int(uid) == 1 and int(gender) == 1 and int(age) == 0
+        assert cat.shape == (18,) and cat.sum() == 3
+        assert rating == 5.0
+        test_rows = list(movielens(str(tmp_path), split="test")())
+        assert len(test_rows) == 1
+
+    def test_uci_housing(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.rand(50, 14)
+        lines = "\n".join(" ".join(f"{v:.4f}" for v in row)
+                          for row in data)
+        (tmp_path / "housing.data").write_text(lines)
+        from paddle_tpu.data.datasets import uci_housing
+        rows = list(uci_housing(str(tmp_path), split="train")())
+        t_rows = list(uci_housing(str(tmp_path), split="test")())
+        assert len(rows) == 40 and len(t_rows) == 10
+        x = np.stack([r[0] for r in rows + t_rows])
+        assert x.shape == (50, 13)
+        # synthetic fallback works without files
+        assert len(list(uci_housing(None)())) > 100
+
+
+class TestChromeTrace:
+    def test_trace_file_valid(self, tmp_path):
+        from paddle_tpu import profiler
+        path = str(tmp_path / "trace.json")
+        with profiler.profile_to_chrome_trace(path):
+            with profiler.record_event("stepA"):
+                jnp.ones((4, 4)).sum().block_until_ready()
+            with profiler.record_event("stepB"):
+                pass
+        trace = json.load(open(path))
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert names == ["stepA", "stepB"]
+        for e in trace["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] >= 0
+
+    def test_summary_still_works(self, capsys):
+        from paddle_tpu import profiler
+        with profiler.profiler(summary=True):
+            with profiler.record_event("x"):
+                pass
+        out = capsys.readouterr().out
+        assert "x" in out and "Calls" in out
+
+
+class TestProgramPrinter:
+    def test_jaxpr_and_hlo(self, capsys):
+        from paddle_tpu.debug import print_program
+        f = lambda x: jnp.tanh(x) @ x
+        text = print_program(f, jnp.ones((3, 3)))
+        assert "tanh" in text and "dot_general" in text
+        hlo = print_program(f, jnp.ones((3, 3)), stage="hlo")
+        assert "stablehlo" in hlo or "HloModule" in hlo or "func" in hlo
+
+    def test_dot_export(self):
+        from paddle_tpu.debug import program_to_dot
+        dot = program_to_dot(lambda x: jnp.tanh(x).sum(), jnp.ones((4,)))
+        assert dot.startswith("digraph")
+        assert "tanh" in dot and "->" in dot
+
+    def test_stage_validation(self):
+        from paddle_tpu.debug import print_program
+        with pytest.raises(ValueError):
+            print_program(lambda x: x, jnp.ones(()), stage="nope")
+
+
+class TestQAT:
+    def _setup(self):
+        from paddle_tpu.models.lenet import LeNet
+        from paddle_tpu.ops import nn as ops_nn
+        model = LeNet(num_classes=4)
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        batch = dict(
+            image=jnp.asarray(rng.randn(4, 28, 28, 1).astype(np.float32)),
+            label=jnp.asarray(rng.randint(0, 4, (4,))))
+
+        def loss_fn(p, image, label):
+            logits = model(p, image)
+            return ops_nn.softmax_with_cross_entropy(
+                logits, label[:, None]).mean(), {}
+
+        return loss_fn, params, batch
+
+    def test_qat_quantizes_forward_but_grads_flow(self):
+        from paddle_tpu import slim
+        loss_fn, params, batch = self._setup()
+        qfn = slim.qat_transform(loss_fn, bit_length=8)
+        (loss, _), grads = jax.value_and_grad(qfn, has_aux=True)(
+            params, **batch)
+        assert np.isfinite(float(loss))
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+        assert sum(float(np.abs(np.asarray(g)).sum()) for g in flat) > 0
+
+    def test_qat_matches_eval_on_converted_weights(self):
+        from paddle_tpu import slim
+        loss_fn, params, batch = self._setup()
+        qparams = slim.qat_convert(params, bit_length=8)
+        qat_loss, _ = slim.qat_transform(loss_fn, bit_length=8)(
+            params, **batch)
+        frozen_loss, _ = loss_fn(qparams, **batch)
+        assert float(qat_loss) == pytest.approx(float(frozen_loss),
+                                                rel=1e-5)
+
+    def test_convert_changes_weights_to_grid(self):
+        from paddle_tpu import slim
+        _, params, _ = self._setup()
+        q = slim.qat_convert(params, bit_length=8)
+        leaf = np.asarray(params["conv1"]["weight"])
+        qleaf = np.asarray(q["conv1"]["weight"])
+        assert qleaf.shape == leaf.shape
+        # values snapped to a 2^7-step grid of the abs-max scale
+        scale = float(np.abs(leaf).max()) / 127.0
+        steps = qleaf / scale
+        np.testing.assert_allclose(steps, np.round(steps), atol=1e-4)
+
+
+class TestReviewRegressions:
+    def test_uci_housing_zero_test_fraction(self, tmp_path):
+        rng = np.random.RandomState(0)
+        lines = "\n".join(" ".join(f"{v:.4f}" for v in row)
+                          for row in rng.rand(10, 14))
+        (tmp_path / "housing.data").write_text(lines)
+        from paddle_tpu.data.datasets import uci_housing
+        rows = list(uci_housing(str(tmp_path), split="train",
+                                test_fraction=0.0)())
+        assert len(rows) == 10               # train keeps everything
+        assert list(uci_housing(str(tmp_path), split="test",
+                                test_fraction=0.0)()) == []
+
+    def test_movielens_gzipped(self, tmp_path):
+        import gzip
+        with gzip.open(tmp_path / "users.dat.gz", "wt") as f:
+            f.write("1::F::1::10::48067\n")
+        with gzip.open(tmp_path / "movies.dat.gz", "wt") as f:
+            f.write("1::Toy Story (1995)::Comedy\n")
+        with gzip.open(tmp_path / "ratings.dat.gz", "wt") as f:
+            f.write("1::1::5::978300760\n1::1::4::978300761\n")
+        from paddle_tpu.data.datasets import movielens
+        rows = list(movielens(str(tmp_path), split="train")())
+        assert len(rows) == 1 and float(rows[0][-1]) == 5.0
+
+    def test_qat_channel_wise_convert_matches_training_grid(self):
+        from paddle_tpu import slim
+        loss_fn, params, batch = self._qat_setup()
+        q = slim.qat_convert(params, channel_wise=True)
+        tr_loss, _ = slim.qat_transform(loss_fn, channel_wise=True)(
+            params, **batch)
+        frozen_loss, _ = loss_fn(q, **batch)
+        assert float(tr_loss) == pytest.approx(float(frozen_loss),
+                                               rel=1e-5)
+
+    def _qat_setup(self):
+        return TestQAT._setup(self)
