@@ -381,3 +381,26 @@ def movielens(data_dir=None, split="train", *, test_fraction=0.1, n=4096):
                    np.float32(rating))
 
     return reader
+
+
+def synthetic_conll05(n=512, seq_len=24, vocab=200, num_tags=9, seed=0):
+    """(words[T] int64, predicate int64, mark[T] int64, labels[T] int64,
+    length int64) — conll05 SRL schema (python/paddle/dataset/conll05.py).
+    Tags correlate with distance to the predicate so a tagger can learn."""
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            ln = r.randint(seq_len // 2, seq_len + 1)
+            words = r.randint(1, vocab, seq_len).astype(np.int64)
+            words[ln:] = 0
+            pred_pos = r.randint(0, ln)
+            mark = np.zeros(seq_len, np.int64)
+            mark[pred_pos] = 1
+            dist = np.abs(np.arange(seq_len) - pred_pos)
+            labels = ((dist + words % 3) % num_tags).astype(np.int64)
+            labels[ln:] = 0
+            yield (words, np.int64(words[pred_pos]), mark, labels,
+                   np.int64(ln))
+
+    return reader

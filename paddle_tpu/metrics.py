@@ -176,6 +176,19 @@ class PrecisionRecall(Metric):
         return {"precision": p, "recall": r, "f1": f1}
 
 
+def _np_box_iou(a, b):
+    """Pure-NumPy IoU (metric code must not dispatch to the device per
+    image — 5000-image evals would round-trip 5000 times)."""
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area1[:, None] + area2[None, :] - inter,
+                              1e-10)
+
+
 class EditDistance(Metric):
     """Streaming mean edit distance (metrics.EditDistance +
     ``edit_distance_op.cc``): Levenshtein distance between predicted and
@@ -255,9 +268,6 @@ class DetectionMAP(Metric):
         """One image. pred_* (K, ...) with bool ``pred_valid``; gt_* (G,
         ...) with bool ``gt_mask``; ``gt_difficult`` (G,) marks boxes
         excluded from the positive count (VOC protocol)."""
-        from paddle_tpu.ops.detection import box_iou
-        import jax.numpy as jnp
-
         pv = np.asarray(pred_valid, bool)
         pb = np.asarray(pred_boxes)[pv]
         ps = np.asarray(pred_scores)[pv]
@@ -274,8 +284,7 @@ class DetectionMAP(Metric):
             self._gt_count[int(cls)] = \
                 self._gt_count.get(int(cls), 0) + n_easy
 
-        iou = (np.asarray(box_iou(jnp.asarray(pb, jnp.float32),
-                                  jnp.asarray(gb, jnp.float32)))
+        iou = (_np_box_iou(pb.astype(np.float32), gb.astype(np.float32))
                if len(pb) and len(gb) else np.zeros((len(pb), len(gb))))
         order = np.argsort(-ps)
         taken = np.zeros(len(gb), bool)

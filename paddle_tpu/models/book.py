@@ -186,3 +186,54 @@ class RecommenderSystem(Layer):
                             movie_id, categories)
         mse = jnp.mean((pred - rating) ** 2)
         return mse, {"mae": jnp.mean(jnp.abs(pred - rating))}
+
+
+class LabelSemanticRoles(Layer):
+    """book/07.label_semantic_roles (test_label_semantic_roles.py): SRL
+    tagger — word + predicate(+mark) embeddings -> stacked BiLSTM ->
+    per-token tag emissions -> linear-chain CRF loss, Viterbi decode.
+    The reference's 8-direction db-lstm becomes a standard deep BiLSTM;
+    the CRF comes from ``ops.crf`` (linear_chain_crf_op parity)."""
+
+    def __init__(self, vocab_size, num_tags, *, dim=32, hidden=32,
+                 depth=2):
+        super().__init__()
+        self.word_emb = Embedding(vocab_size, dim)
+        self.pred_emb = Embedding(vocab_size, dim)
+        self.mark_emb = Embedding(2, dim // 2)
+        self.lstm = LSTM(2 * dim + dim // 2, hidden, num_layers=depth,
+                         bidirectional=True)
+        self.fc = Linear(self.lstm.output_size, num_tags, sharding=None)
+        self.transition = self.create_parameter(
+            "transition", (num_tags, num_tags), initializer=I.zeros)
+        self.start = self.create_parameter("start", (num_tags,),
+                                           initializer=I.zeros)
+        self.stop = self.create_parameter("stop", (num_tags,),
+                                          initializer=I.zeros)
+
+    def emissions(self, params, words, predicate, mark, lengths):
+        x = jnp.concatenate([
+            self.word_emb(params["word_emb"], words),
+            self.pred_emb(params["pred_emb"],
+                          jnp.broadcast_to(predicate[:, None],
+                                           words.shape)),
+            self.mark_emb(params["mark_emb"], mark)], -1)
+        h, _ = self.lstm(params["lstm"], x, lengths)
+        return self.fc(params["fc"], h)
+
+    def loss(self, params, words, predicate, mark, labels, lengths, *,
+             training=True, key=None):
+        del training, key
+        from paddle_tpu.ops import crf as crf_ops
+        em = self.emissions(params, words, predicate, mark, lengths)
+        nll = crf_ops.linear_chain_crf(
+            em, labels, lengths, params["transition"],
+            start=params["start"], stop=params["stop"])
+        return nll.mean(), {}
+
+    def decode(self, params, words, predicate, mark, lengths):
+        from paddle_tpu.ops import crf as crf_ops
+        em = self.emissions(params, words, predicate, mark, lengths)
+        return crf_ops.crf_decoding(em, params["transition"], lengths,
+                                    start=params["start"],
+                                    stop=params["stop"])

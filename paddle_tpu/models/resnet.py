@@ -37,6 +37,77 @@ class ConvBNLayer(Layer):
         return x
 
 
+def space_to_depth(x, block=2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C); channel order (r, s, c)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c)
+
+
+class S2DStemConv(Layer):
+    """MXU-friendly ResNet stem: the 7x7/stride-2 conv on 3 channels is
+    mathematically re-expressed as a 4x4/stride-1 conv on the 2x2
+    space-to-depth-blocked 12-channel input (the MLPerf-style transform —
+    identical function, 4x the contraction channels, no strided gather).
+    Weights are STORED blocked (4, 4, 4*in_ch, out); use
+    :func:`stem_weights_to_s2d` to convert a trained 7x7 checkpoint."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        # fan_in of the equivalent 7x7 conv (49 taps, not 16*4): keeps the
+        # init distribution of the standard stem
+        self.weight = self.create_parameter(
+            "weight", (4, 4, 4 * in_ch, out_ch),
+            initializer=I.msra_normal(fan_in=in_ch * 49))
+
+    def forward(self, params, x):
+        xb = space_to_depth(x, 2)
+        return jax.lax.conv_general_dilated(
+            xb, params["weight"].astype(xb.dtype), (1, 1),
+            ((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def stem_weights_to_s2d(w7):
+    """(7, 7, C, O) standard stem weights -> (4, 4, 4C, O) blocked weights
+    computing the identical function (pixel (2a+r, 2b+s) lives in blocked
+    channel slot (2r+s)*C + c; kernel tap i = 2*ka + r - 1)."""
+    k, k2, c, o = w7.shape
+    if (k, k2) != (7, 7):
+        raise ValueError(f"expected 7x7 stem weights, got {w7.shape}")
+    wb = jnp.zeros((4, 4, 4 * c, o), w7.dtype)
+    for ka in range(4):
+        for r in range(2):
+            i = 2 * ka + r - 1
+            if not 0 <= i <= 6:
+                continue
+            for kb in range(4):
+                for s in range(2):
+                    j = 2 * kb + s - 1
+                    if not 0 <= j <= 6:
+                        continue
+                    sl = (r * 2 + s) * c
+                    wb = wb.at[ka, kb, sl:sl + c, :].set(w7[i, j])
+    return wb
+
+
+class S2DStem(Layer):
+    """ConvBNLayer-shaped wrapper so the param tree keeps the
+    stem/{conv,bn} structure (checkpoint layout parity with the 7x7 stem:
+    only the conv weight shape differs)."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.conv = S2DStemConv(in_ch, out_ch)
+        self.bn = BatchNorm(out_ch)
+
+    def forward(self, params, x, training=False):
+        x = self.conv(params["conv"], x)
+        x = self.bn(params["bn"], x, training=training)
+        return jax.nn.relu(x)
+
+
 class BottleneckBlock(Layer):
     expansion = 4
 
@@ -90,12 +161,16 @@ class ResNet(Layer):
     """NHWC ResNet. ``width`` scales channel counts (width=64 standard;
     tests use small widths)."""
 
-    def __init__(self, depth=50, num_classes=1000, width=64, in_ch=3):
+    def __init__(self, depth=50, num_classes=1000, width=64, in_ch=3,
+                 stem="conv7"):
         super().__init__()
         if depth not in _DEPTHS:
             raise ValueError(f"depth must be one of {sorted(_DEPTHS)}")
+        if stem not in ("conv7", "s2d"):
+            raise ValueError(f"stem must be 'conv7' or 's2d', got {stem!r}")
         block_cls, counts = _DEPTHS[depth]
-        self.stem = ConvBNLayer(in_ch, width, 7, stride=2, act="relu")
+        self.stem = (S2DStem(in_ch, width) if stem == "s2d" else
+                     ConvBNLayer(in_ch, width, 7, stride=2, act="relu"))
         self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
         blocks = []
         ch_in = width

@@ -5,8 +5,9 @@ Importing this package registers every op into the OpInfoMap
 pattern (op_registry.h:199) without global constructors.
 """
 
-from paddle_tpu.ops import (activation, attention, detection, elementwise,
-                            math, nn, reduction, sequence, tensor)
+from paddle_tpu.ops import (activation, attention, crf, detection,
+                            elementwise, math, nn, reduction, sequence,
+                            tensor)
 from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
                                       flash_attention,
                                       scaled_dot_product_attention)

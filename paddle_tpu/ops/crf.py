@@ -1,0 +1,147 @@
+"""Linear-chain CRF + CTC — the structured-prediction tail of the
+reference op library.
+
+Reference mapping:
+- ``operators/linear_chain_crf_op.cc`` (forward-algorithm negative
+  log-likelihood; the reference hand-codes the gradient, here autodiff
+  differentiates the log-partition scan).
+- ``operators/crf_decoding_op.cc`` (Viterbi decode).
+- ``operators/warpctc_op.cc`` (CTC loss via the external warp-ctc library;
+  here optax's native XLA ctc_loss).
+
+TPU design: batches are padded (B, T, N) with per-row lengths — the LoD
+analog — and both the forward pass and Viterbi are ``lax.scan``s over
+time, masked past each row's length, so one compiled program serves every
+bucket shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _scan_log_alpha(emission, transition, length):
+    """log-alpha recursion for one row: emission (T, N), transition
+    (N, N) [from, to]. Returns logZ (scalar, masked at ``length``)."""
+    t_len, n = emission.shape
+
+    def step(alpha, inp):
+        emit, t = inp
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i, j]) + emit[j]
+        nxt = jax.nn.logsumexp(alpha[:, None] + transition, axis=0) + emit
+        alpha = jnp.where(t < length, nxt, alpha)
+        return alpha, None
+
+    alpha0 = emission[0]
+    alpha, _ = jax.lax.scan(
+        step, alpha0, (emission[1:], jnp.arange(1, t_len)))
+    return jax.nn.logsumexp(alpha)
+
+
+def _gold_score(emission, label, transition, length):
+    t_len = emission.shape[0]
+    idx = jnp.arange(t_len)
+    emit = jnp.take_along_axis(emission, label[:, None], -1)[:, 0]
+    emit = jnp.where(idx < length, emit, 0.0).sum()
+    trans = transition[label[:-1], label[1:]]
+    trans = jnp.where(idx[1:] < length, trans, 0.0).sum()
+    return emit + trans
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(emission, label, length, transition, *,
+                     start=None, stop=None):
+    """Per-sequence negative log-likelihood (linear_chain_crf_op).
+    ``emission`` (B, T, N) unary scores; ``label`` (B, T) int gold tags;
+    ``length`` (B,) valid steps per row; ``transition`` (N, N) [from, to];
+    optional ``start``/``stop`` (N,) boundary scores (the reference packs
+    them as the two extra rows of its (N+2, N) transition tensor).
+    Returns (B,) NLL; gradients flow to emission/transition/start/stop via
+    autodiff (≙ the hand-written grad kernel)."""
+    n = emission.shape[-1]
+    if start is not None:
+        emission = emission.at[:, 0, :].add(start[None, :])
+    if stop is not None:
+        # add stop score at each row's last valid step
+        last = jnp.maximum(length - 1, 0)
+        emission = emission + (
+            (jnp.arange(emission.shape[1])[None, :, None]
+             == last[:, None, None]) * stop[None, None, :])
+
+    def one(em, lab, ln):
+        logz = _scan_log_alpha(em, transition, ln)
+        gold = _gold_score(em, lab, transition, ln)
+        return logz - gold
+
+    return jax.vmap(one)(emission, label, length)
+
+
+@register_op("crf_decoding")
+def crf_decoding(emission, transition, length, *, start=None, stop=None,
+                 label=None):
+    """Viterbi decode (crf_decoding_op). Same layouts as
+    :func:`linear_chain_crf`. Returns (B, T) best paths (entries past
+    ``length`` are 0). With ``label`` given, returns instead a (B, T)
+    0/1 mismatch mask like the reference (1 where decoded != label,
+    only within length)."""
+    b, t_len, n = emission.shape
+    if start is not None:
+        emission = emission.at[:, 0, :].add(start[None, :])
+    if stop is not None:
+        last = jnp.maximum(length - 1, 0)
+        emission = emission + (
+            (jnp.arange(t_len)[None, :, None]
+             == last[:, None, None]) * stop[None, None, :])
+
+    def one(em, ln):
+        def fwd(carry, inp):
+            score, t = carry, inp[0]
+            emit = inp[1]
+            cand = score[:, None] + transition           # (from, to)
+            best_prev = jnp.argmax(cand, axis=0)         # (N,)
+            nxt = cand.max(axis=0) + emit
+            keep = t < ln
+            score = jnp.where(keep, nxt, score)
+            ptr = jnp.where(keep, best_prev,
+                            jnp.arange(n))               # identity ptr
+            return score, ptr
+
+        score, ptrs = jax.lax.scan(
+            fwd, em[0], (jnp.arange(1, t_len), em[1:]))
+        last_tag = jnp.argmax(score)
+
+        def back(tag, ptr):
+            prev = ptr[tag]
+            return prev, tag
+
+        # reverse scan emits tag_{t} at index t-1 and finishes carrying
+        # tag_0: prepend it (NOT append last_tag — it is already emitted)
+        tag0, path = jax.lax.scan(back, last_tag, ptrs, reverse=True)
+        path = jnp.concatenate([tag0[None], path])
+        return jnp.where(jnp.arange(t_len) < ln, path, 0)
+
+    paths = jax.vmap(one)(emission, length)
+    if label is not None:
+        mism = (paths != label) & (
+            jnp.arange(t_len)[None, :] < length[:, None])
+        return mism.astype(jnp.int32)
+    return paths
+
+
+@register_op("warpctc")
+def ctc_loss(logits, logit_lengths, labels, label_lengths, *, blank=0):
+    """CTC loss (warpctc_op semantics, XLA-native via optax).
+    ``logits`` (B, T, V) unnormalized; ``labels`` (B, L) int padded.
+    Returns (B,) per-sequence loss."""
+    import optax
+
+    b, t_len, _ = logits.shape
+    logitpad = (jnp.arange(t_len)[None, :]
+                >= logit_lengths[:, None]).astype(jnp.float32)
+    labelpad = (jnp.arange(labels.shape[1])[None, :]
+                >= label_lengths[:, None]).astype(jnp.float32)
+    return optax.ctc_loss(logits, logitpad, labels, labelpad,
+                          blank_id=blank)

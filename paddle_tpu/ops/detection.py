@@ -461,6 +461,13 @@ def target_assign(x, match_indices, mismatch_value=0.0):
     return out, matched.astype(jnp.float32)
 
 
+def _stable_bce(logits, targets):
+    """max(x,0) - x*t + log1p(exp(-|x|)) — the overflow-safe sigmoid BCE
+    shared by focal and YOLOv3 losses."""
+    return (jnp.maximum(logits, 0.0) - logits * targets
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
 def topk_mask(mask, score, limit):
     """Keep at most ``limit`` (dynamic) True entries of ``mask``, the ones
     with the highest ``score`` — the static-shape "dynamic count as a rank
@@ -535,12 +542,11 @@ def sigmoid_focal_loss(logits, labels, *, gamma=2.0, alpha=0.25,
     ``labels`` (N,) int in [0, C] where 0 = background and class k maps to
     column k-1 (the reference convention). Returns the per-element (N, C)
     loss, optionally divided by ``normalizer`` (foreground count)."""
-    n, c = logits.shape
+    c = logits.shape[1]
     t = (labels[:, None] == jnp.arange(1, c + 1)[None, :]).astype(
         logits.dtype)
     p = jax.nn.sigmoid(logits)
-    bce = (jnp.maximum(logits, 0.0) - logits * t
-           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    bce = _stable_bce(logits, t)
     p_t = p * t + (1.0 - p) * (1.0 - t)
     a_t = alpha * t + (1.0 - alpha) * (1.0 - t)
     loss = a_t * (1.0 - p_t) ** gamma * bce
@@ -635,10 +641,7 @@ def yolov3_loss(x, gt_boxes, gt_labels, gt_mask, *, anchors, anchor_mask,
         t_obj, t_xy, t_wh, t_cls, t_scale = jax.lax.fori_loop(
             0, g, assign, (t_obj, t_xy, t_wh, t_cls, t_scale))
 
-        def bce(logit, target):
-            return (jnp.maximum(logit, 0.0) - logit * target
-                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
-
+        bce = _stable_bce
         pos = t_obj > 0
         sc = t_scale * pos
         loss_xy = (bce(head[..., 0:2], t_xy).sum(-1) * sc).sum()
@@ -665,8 +668,17 @@ def rpn_target_assign(anchors, gt_boxes, gt_mask, *, im_shape=None,
     otherwise the hardest (highest/lowest IoU) are kept deterministically.
     Returns (labels (P,) int32, bbox_targets (P, 4), pos_mask, neg_mask)."""
     p = anchors.shape[0]
+    inside = None
+    if im_shape is not None:
+        h, w = im_shape[0], im_shape[1]
+        inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+                  & (anchors[:, 2] <= w - 1) & (anchors[:, 3] <= h - 1))
     iou = box_iou(gt_boxes, anchors)                          # (G, P)
     iou = jnp.where(gt_mask[:, None], iou, -1.0)
+    if inside is not None:
+        # rpn_target_assign_op.cc excludes anchors straddling the image
+        # boundary from labeling entirely (they stay -1 / ignored)
+        iou = jnp.where(inside[None, :], iou, -1.0)
     best_gt = jnp.argmax(iou, axis=0)                         # per anchor
     best_iou = jnp.max(iou, axis=0)
     # each gt's best anchor is always fg (ties broadcast via equality) —
@@ -684,6 +696,9 @@ def rpn_target_assign(anchors, gt_boxes, gt_mask, *, im_shape=None,
     rand = (jax.random.uniform(key, (p,)) if key is not None
             else jnp.zeros((p,)))
 
+    if inside is not None:
+        fg = fg & inside
+        bg = bg & inside
     fg = topk_mask(fg, best_iou + rand, max_fg)
     n_fg = fg.sum()
     bg = topk_mask(bg, -best_iou + rand, batch_size_per_im - n_fg)
@@ -807,7 +822,6 @@ def retinanet_detection_output(boxes_list, scores_list, anchors_list,
     _, sel = jax.lax.top_k(scores.max(axis=1), k)
     boxes = boxes[sel]
     scores = scores[sel]
-    c = scores.shape[1]
     per = max(1, keep_top_k)
     cls_ids, idxs, valid = multiclass_nms(
         boxes, scores, iou_threshold=nms_threshold,

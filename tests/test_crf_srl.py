@@ -1,0 +1,218 @@
+"""Linear-chain CRF / CTC ops + the label-semantic-roles book chapter.
+
+OpTest-style: CRF NLL against brute-force enumeration of all paths; Viterbi
+against brute-force argmax; CTC against a degenerate case with a known
+closed form; then the BiLSTM-CRF SRL model end-to-end on the conll05
+synthetic schema."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import crf as C
+
+
+def brute_force_logz(em, trans, start, stop, ln):
+    n = em.shape[1]
+    scores = []
+    for path in itertools.product(range(n), repeat=ln):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, ln):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        s += stop[path[ln - 1]]
+        scores.append(s)
+    m = max(scores)
+    return m + np.log(sum(np.exp(s - m) for s in scores))
+
+
+def brute_force_best(em, trans, start, stop, ln):
+    n = em.shape[1]
+    best, best_s = None, -np.inf
+    for path in itertools.product(range(n), repeat=ln):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, ln):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        s += stop[path[ln - 1]]
+        if s > best_s:
+            best, best_s = path, s
+    return best
+
+
+class TestLinearChainCRF:
+    def _inputs(self, b=2, t=5, n=3, seed=0):
+        rng = np.random.RandomState(seed)
+        em = rng.randn(b, t, n).astype(np.float32)
+        trans = rng.randn(n, n).astype(np.float32) * 0.5
+        start = rng.randn(n).astype(np.float32) * 0.3
+        stop = rng.randn(n).astype(np.float32) * 0.3
+        label = rng.randint(0, n, (b, t))
+        length = np.array([t, t - 2])
+        return em, trans, start, stop, label, length
+
+    def test_nll_matches_brute_force(self):
+        em, trans, start, stop, label, length = self._inputs()
+        nll = np.asarray(C.linear_chain_crf(
+            jnp.asarray(em), jnp.asarray(label), jnp.asarray(length),
+            jnp.asarray(trans), start=jnp.asarray(start),
+            stop=jnp.asarray(stop)))
+        for i in range(em.shape[0]):
+            ln = int(length[i])
+            logz = brute_force_logz(em[i], trans, start, stop, ln)
+            gold = start[label[i, 0]] + em[i, 0, label[i, 0]]
+            for t in range(1, ln):
+                gold += trans[label[i, t - 1], label[i, t]] + \
+                    em[i, t, label[i, t]]
+            gold += stop[label[i, ln - 1]]
+            np.testing.assert_allclose(nll[i], logz - gold, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_nll_nonnegative_and_grad_flows(self):
+        em, trans, start, stop, label, length = self._inputs(seed=1)
+
+        def loss(em_, tr_):
+            return C.linear_chain_crf(
+                em_, jnp.asarray(label), jnp.asarray(length), tr_,
+                start=jnp.asarray(start), stop=jnp.asarray(stop)).mean()
+
+        l0 = float(loss(jnp.asarray(em), jnp.asarray(trans)))
+        assert l0 > 0          # NLL of a random path is positive
+        ge, gt = jax.grad(loss, argnums=(0, 1))(jnp.asarray(em),
+                                                jnp.asarray(trans))
+        assert np.isfinite(np.asarray(ge)).all()
+        assert np.abs(np.asarray(gt)).sum() > 0
+        # grads past each row's length must be zero (masked)
+        assert np.abs(np.asarray(ge)[1, -2:]).max() == 0.0
+
+    def test_viterbi_matches_brute_force(self):
+        em, trans, start, stop, _, length = self._inputs(seed=2)
+        paths = np.asarray(C.crf_decoding(
+            jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
+            start=jnp.asarray(start), stop=jnp.asarray(stop)))
+        for i in range(em.shape[0]):
+            ln = int(length[i])
+            ref = brute_force_best(em[i], trans, start, stop, ln)
+            np.testing.assert_array_equal(paths[i, :ln], ref)
+            assert (paths[i, ln:] == 0).all()
+
+    def test_decoding_mismatch_mask(self):
+        em, trans, start, stop, _, length = self._inputs(seed=3)
+        paths = C.crf_decoding(
+            jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
+            start=jnp.asarray(start), stop=jnp.asarray(stop))
+        mism = np.asarray(C.crf_decoding(
+            jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
+            start=jnp.asarray(start), stop=jnp.asarray(stop),
+            label=paths))
+        assert mism.sum() == 0          # decoded vs itself: no mismatch
+
+    def test_training_reduces_nll(self):
+        rng = np.random.RandomState(4)
+        b, t, n = 8, 6, 4
+        em0 = jnp.asarray(rng.randn(b, t, n).astype(np.float32) * 0.1)
+        label = jnp.asarray(rng.randint(0, n, (b, t)))
+        length = jnp.full((b,), t)
+        trans = jnp.zeros((n, n))
+
+        def loss(args):
+            em_, tr_ = args
+            return C.linear_chain_crf(em_, label, length, tr_).mean()
+
+        args = (em0, trans)
+        g = jax.jit(jax.grad(loss))
+        l0 = float(loss(args))
+        for _ in range(30):
+            ge, gt = g(args)
+            args = (args[0] - 0.5 * ge, args[1] - 0.5 * gt)
+        l1 = float(loss(args))
+        assert l1 < l0 * 0.5
+
+
+class TestCTC:
+    def test_single_label_repeated_logit(self):
+        # V=2 (blank=0, symbol=1), T=2, label="1": paths {1b, b1, 11}
+        logits = jnp.zeros((1, 2, 2))      # uniform: each frame p=0.5
+        loss = float(C.ctc_loss(logits, jnp.asarray([2]),
+                                jnp.asarray([[1]]), jnp.asarray([1]))[0])
+        # P(label) = 3 * 0.25 = 0.75; NLL = -ln(0.75)
+        np.testing.assert_allclose(loss, -np.log(0.75), rtol=1e-4)
+
+    def test_perfect_alignment_low_loss(self):
+        t, v = 6, 5
+        labels = jnp.asarray([[1, 2, 3]])
+        frames = [1, 1, 2, 2, 3, 3]
+        logits = 10.0 * jax.nn.one_hot(jnp.asarray([frames]), v)
+        loss = float(C.ctc_loss(logits, jnp.asarray([t]), labels,
+                                jnp.asarray([3]))[0])
+        assert loss < 0.1
+
+
+class TestLabelSemanticRoles:
+    def _batch(self, n=32):
+        from paddle_tpu.data.datasets import synthetic_conll05
+        rows = []
+        for i, row in enumerate(synthetic_conll05()()):
+            rows.append(row)
+            if i + 1 == n:
+                break
+        w, p, m, l, ln = (np.stack(c) for c in zip(*rows))
+        return dict(words=jnp.asarray(w), predicate=jnp.asarray(p),
+                    mark=jnp.asarray(m), labels=jnp.asarray(l),
+                    lengths=jnp.asarray(ln))
+
+    def test_trains_and_decodes(self):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.book import LabelSemanticRoles
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        model = LabelSemanticRoles(vocab_size=200, num_tags=9, dim=16,
+                                   hidden=16, depth=1)
+        batch = self._batch()
+        optimizer = opt.Adam(learning_rate=5e-3)
+        step = jax.jit(build_train_step(
+            lambda p, **b: model.loss(p, **b), optimizer))
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(10):
+            state, m = step(state, **batch)
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+        paths = model.decode(state["params"], batch["words"],
+                             batch["predicate"], batch["mark"],
+                             batch["lengths"])
+        assert paths.shape == batch["labels"].shape
+        assert (np.asarray(paths) < 9).all() and \
+            (np.asarray(paths) >= 0).all()
+
+    def test_decode_improves_with_training(self):
+        # tag accuracy after training beats the untrained model
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.book import LabelSemanticRoles
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        model = LabelSemanticRoles(vocab_size=200, num_tags=9, dim=16,
+                                   hidden=16, depth=1)
+        batch = self._batch(64)
+        optimizer = opt.Adam(learning_rate=5e-3)
+        step = jax.jit(build_train_step(
+            lambda p, **b: model.loss(p, **b), optimizer))
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+        def acc(params):
+            paths = np.asarray(model.decode(
+                params, batch["words"], batch["predicate"],
+                batch["mark"], batch["lengths"]))
+            lab = np.asarray(batch["labels"])
+            mask = (np.arange(lab.shape[1])[None, :]
+                    < np.asarray(batch["lengths"])[:, None])
+            return (paths == lab)[mask].mean()
+
+        a0 = acc(state["params"])
+        for _ in range(30):
+            state, _ = step(state, **batch)
+        a1 = acc(state["params"])
+        assert a1 > a0 + 0.05, (a0, a1)

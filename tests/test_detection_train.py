@@ -382,3 +382,17 @@ class TestReviewRegressions:
             nms_top_k=8, keep_top_k=4, score_threshold=0.0)
         assert boxes.shape == (4, 4)
         assert np.asarray(valid).any()
+
+    def test_rpn_im_shape_excludes_boundary_anchors(self):
+        anchors = jnp.asarray([[0, 0, 10, 10],      # inside
+                               [-5, 0, 5, 10],      # straddles left edge
+                               [56, 56, 70, 70]],   # straddles right edge
+                              jnp.float32)
+        gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        labels, _, fg, bg = D.rpn_target_assign(
+            anchors, gt, jnp.ones((1,), bool),
+            im_shape=jnp.asarray([64.0, 64.0]))
+        lab = np.asarray(labels)
+        assert lab[0] == 1          # inside + perfect IoU
+        assert lab[1] == -1         # boundary anchors are ignored
+        assert lab[2] == -1

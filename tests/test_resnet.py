@@ -83,3 +83,56 @@ def test_dp_sharded_train_step(mesh8):
         run, placed = papi.shard_train_step(step, mesh8, state)
         new_state, metrics = run(placed, image=x, label=y)
     assert np.isfinite(float(metrics["loss"]))
+
+
+class TestS2DStem:
+    """Space-to-depth stem: exact reparametrization of the 7x7/s2 stem
+    (MXU-friendly; bench.py uses it on TPU)."""
+
+    def test_weight_conversion_preserves_function(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models.resnet import (ResNet, stem_weights_to_s2d)
+
+        m7 = ResNet(50, width=16, num_classes=10)
+        ms = ResNet(50, width=16, num_classes=10, stem="s2d")
+        p7 = m7.init(jax.random.PRNGKey(0))
+        ps = ms.init(jax.random.PRNGKey(0))
+        ps["stem"]["conv"]["weight"] = stem_weights_to_s2d(
+            p7["stem"]["conv"]["weight"])
+        ps["stem"]["bn"] = p7["stem"]["bn"]
+        ps["blocks"] = p7["blocks"]
+        ps["fc"] = p7["fc"]
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 64, 3))
+        s7 = m7.stem(p7["stem"], x)
+        ss = ms.stem(ps["stem"], x)
+        assert float(jnp.max(jnp.abs(s7 - ss))) < 1e-4
+
+    def test_s2d_trains(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.resnet import ResNet
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        model = ResNet(50, width=8, num_classes=4, stem="s2d")
+        optimizer = opt.Adam(learning_rate=1e-3)
+        step = jax.jit(build_train_step(
+            lambda p, **b: model.loss(p, training=True, **b), optimizer))
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        batch = dict(
+            image=jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32)),
+            label=jnp.asarray(rng.randint(0, 4, (4,))))
+        losses = []
+        for _ in range(6):
+            state, m = step(state, **batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] and np.isfinite(losses).all()
+
+    def test_bad_stem_rejected(self):
+        import pytest
+        from paddle_tpu.models.resnet import ResNet
+        with pytest.raises(ValueError):
+            ResNet(50, stem="nope")
