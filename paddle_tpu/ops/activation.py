@@ -138,3 +138,33 @@ def logsigmoid(x):
              scale_b * np.tanh(scale_a * x))
 def stanh(x, scale_a=0.67, scale_b=1.7159):
     return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("acos", reference=np.arccos)
+def acos(x):
+    """acos activation (activation_op.cc AcosFunctor)."""
+    return jnp.arccos(x)
+
+
+@register_op("asin", reference=np.arcsin)
+def asin(x):
+    """asin activation."""
+    return jnp.arcsin(x)
+
+
+@register_op("atan", reference=np.arctan)
+def atan(x):
+    """atan activation."""
+    return jnp.arctan(x)
+
+
+@register_op("brelu", reference=None)
+def brelu(x, t_min=0.0, t_max=24.0):
+    """brelu: clip(x, t_min, t_max) (activation_op.cc BReluFunctor)."""
+    return jnp.clip(x, t_min, t_max)
+
+
+@register_op("soft_relu", reference=None)
+def soft_relu(x, threshold=40.0):
+    """soft_relu: log(1 + exp(clip(x, -t, t)))."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))

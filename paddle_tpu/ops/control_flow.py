@@ -76,3 +76,48 @@ jax.tree_util.register_pytree_node(
     lambda size, bufs: TensorArray(size, bufs[0].shape[1:], bufs[0].dtype,
                                    bufs[0]),
 )
+
+
+# --- fluid array-layer aliases over TensorArray (layers.create_array,
+# array_read/array_write/array_length, tensor_array_to_tensor) ------------
+
+def create_array(size, example):
+    """layers.create_array: a TensorArray of ``size`` slots shaped like
+    ``example``."""
+    return TensorArray(size, example.shape, example.dtype)
+
+
+def array_write(arr, i, x):
+    """layers.array_write (functional: returns the new array)."""
+    return arr.write(i, x)
+
+
+def array_read(arr, i):
+    """layers.array_read."""
+    return arr.read(i)
+
+
+def array_length(arr):
+    """layers.array_length."""
+    return arr.size
+
+
+def tensor_array_to_tensor(arr, axis=0):
+    """tensor_array_to_tensor_op: stack (axis=0 insert) or concat along
+    an existing axis."""
+    import jax.numpy as jnp
+    stacked = arr.stack()
+    if axis == 0:
+        return stacked
+    parts = [jax.lax.index_in_dim(stacked, i, 0, keepdims=False)
+             for i in range(stacked.shape[0])]
+    return jnp.concatenate(parts, axis=axis - 1)
+
+
+def py_func(fn, args, out_shape_dtype):
+    """layers.py_func (py_func_op): run a host-side Python function inside
+    a traced program. TPU-native form: ``jax.pure_callback`` — the host
+    function must be pure (the reference documents the same requirement);
+    ``out_shape_dtype`` is a pytree of jax.ShapeDtypeStruct (static shapes,
+    as XLA requires)."""
+    return jax.pure_callback(fn, out_shape_dtype, *args)

@@ -145,3 +145,65 @@ def ctc_loss(logits, logit_lengths, labels, label_lengths, *, blank=0):
                 >= label_lengths[:, None]).astype(jnp.float32)
     return optax.ctc_loss(logits, logitpad, labels, labelpad,
                           blank_id=blank)
+
+
+@register_op("ctc_greedy_decoder", has_grad=False)
+def ctc_greedy_decoder(probs, lengths, *, blank=0):
+    """layers.ctc_greedy_decoder (ctc_align_op): per-frame argmax, merge
+    repeats, drop blanks. Static shapes: returns (tokens (B, T) padded
+    with ``blank``, out_lengths (B,))."""
+    b, t, v = probs.shape
+    ids = jnp.argmax(probs, -1)                               # (B, T)
+    frame_valid = jnp.arange(t)[None, :] < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1), ids[:, :-1]], 1)
+    keep = (ids != blank) & (ids != prev) & frame_valid
+
+    def compact(row_ids, row_keep):
+        # stable order: kept tokens first (argsort of ~keep is stable)
+        order = jnp.argsort(~row_keep)
+        out = jnp.where(row_keep[order], row_ids[order], blank)
+        return out
+
+    tokens = jax.vmap(compact)(ids, keep)
+    return tokens, keep.sum(-1)
+
+
+@register_op("edit_distance", has_grad=False)
+def edit_distance(hyp, hyp_lengths, ref, ref_lengths, *,
+                  normalized=True):
+    """edit_distance_op: in-graph Levenshtein DP between padded int
+    sequences — (B, L1), (B, L2) with per-row lengths. The DP runs as a
+    scan over hypothesis tokens carrying one (L2+1) row (static shapes);
+    padded positions are neutralized by clamping to the row lengths."""
+    l2 = ref.shape[1]
+
+    def one(h_row, h_len, r_row, r_len):
+        init = jnp.arange(l2 + 1, dtype=jnp.float32)
+        init = jnp.minimum(init, r_len.astype(jnp.float32))
+
+        def step(prev, inp):
+            tok, i = inp
+            active = i < h_len
+
+            def row_fn(carry, j):
+                diag, left = carry
+                up = prev[j + 1]
+                sub = diag + (tok != r_row[j])
+                best = jnp.minimum(jnp.minimum(up + 1, left + 1), sub)
+                best = jnp.where(j < r_len, best, left)  # clamp at r_len
+                return (up, best), best
+
+            first = prev[0] + 1.0
+            (_, _), rest = jax.lax.scan(row_fn, (prev[0], first),
+                                        jnp.arange(l2))
+            cur = jnp.concatenate([first[None], rest])
+            return jnp.where(active, cur, prev), None
+
+        final, _ = jax.lax.scan(
+            step, init, (h_row, jnp.arange(h_row.shape[0])))
+        d = final[jnp.minimum(r_len, l2)]
+        if normalized:
+            d = d / jnp.maximum(r_len, 1)
+        return d
+
+    return jax.vmap(one)(hyp, hyp_lengths, ref, ref_lengths)

@@ -833,3 +833,244 @@ def retinanet_detection_output(boxes_list, scores_list, anchors_list,
     out_valid = jnp.isfinite(top_s)
     return (boxes[idxs[order]], cls_ids[order],
             jnp.where(out_valid, top_s, 0.0), out_valid)
+
+
+@register_op("detection_output")
+def detection_output(loc, conf, anchors, *, score_threshold=0.01,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     variances=(0.1, 0.1, 0.2, 0.2),
+                     background_label=0):
+    """layers.detection_output (SSD post-process): decode + per-class NMS
+    + global top-k. ``loc`` (B, P, 4) deltas; ``conf`` (B, P, C) logits.
+    Returns per image (boxes (K, 4), cls (K,), scores (K,), valid)."""
+
+    def one(loc_i, conf_i):
+        boxes = box_decode(loc_i, anchors, variances)
+        probs = jax.nn.softmax(conf_i.astype(jnp.float32), -1)
+        fg = jnp.concatenate([probs[:, :background_label],
+                              probs[:, background_label + 1:]], -1)
+        # per-class cap is nms_top_k (reference semantics) — NOT
+        # keep_top_k split across classes, which would starve crowded
+        # single-class scenes; the global keep_top_k cut comes after
+        per = max(1, min(nms_top_k, boxes.shape[0]))
+        cls_ids, idxs, valid = multiclass_nms(
+            boxes, fg, iou_threshold=nms_threshold,
+            score_threshold=score_threshold, max_per_class=per)
+        sel = jnp.where(valid, fg[idxs, cls_ids], -jnp.inf)
+        k = min(keep_top_k, sel.shape[0])
+        top_s, order = jax.lax.top_k(sel, k)
+        ok = jnp.isfinite(top_s)
+        cls = cls_ids[order]
+        cls = jnp.where(cls >= background_label, cls + 1, cls)
+        return (boxes[idxs[order]], cls, jnp.where(ok, top_s, 0.0), ok)
+
+    return jax.vmap(one)(loc, conf)
+
+
+def multiclass_nms2(boxes, scores, *, iou_threshold=0.45,
+                    score_threshold=0.01, max_per_class=100):
+    """multiclass_nms2_op: multiclass_nms that ALSO returns the input-box
+    indices (the reference's second output)."""
+    cls_ids, idxs, valid = multiclass_nms(
+        boxes, scores, iou_threshold=iou_threshold,
+        score_threshold=score_threshold, max_per_class=max_per_class)
+    return cls_ids, idxs, valid, idxs
+
+
+@register_op("box_decoder_and_assign")
+def box_decoder_and_assign(prior_box, deltas, scores, *,
+                           variances=(0.1, 0.1, 0.2, 0.2),
+                           box_clip_value=4.135):
+    """box_decoder_and_assign_op (Cascade R-CNN): decode per-class box
+    deltas (P, C*4) and pick each prior's best-scoring class box.
+    Returns (decoded (P, C, 4), assigned (P, 4))."""
+    p, c4 = deltas.shape
+    c = c4 // 4
+    d = deltas.reshape(p, c, 4)
+    d = d.at[:, :, 2:].set(jnp.clip(d[:, :, 2:], -box_clip_value,
+                                    box_clip_value))
+    decoded = jax.vmap(lambda dc: box_decode(dc, prior_box, variances),
+                       in_axes=1, out_axes=1)(d)
+    best = jnp.argmax(scores[:, :c], axis=-1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), 1)[:, 0]
+    return decoded, assigned
+
+
+@register_op("retinanet_target_assign")
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, gt_mask, *,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            variances=(1.0, 1.0, 1.0, 1.0)):
+    """retinanet_target_assign_op: anchor labeling for focal-loss heads —
+    labels: gt class (>=1) above positive_overlap or per-gt argmax, 0
+    below negative_overlap, -1 between (ignored). Returns (cls_targets
+    (P,), bbox_targets (P, 4), fg_mask, fg_num)."""
+    iou = box_iou(gt_boxes, anchors)
+    iou = jnp.where(gt_mask[:, None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=0)
+    best_iou = jnp.max(iou, axis=0)
+    gt_best = jnp.max(jnp.where(gt_mask[:, None], iou, -jnp.inf), axis=1)
+    forced = ((iou >= gt_best[:, None]) & gt_mask[:, None]
+              & (gt_best[:, None] > 0)).any(0)
+    fg = forced | (best_iou >= positive_overlap)
+    bg = (~fg) & (best_iou < negative_overlap)
+    cls = jnp.where(fg, gt_labels[best_gt],
+                    jnp.where(bg, 0, -1)).astype(jnp.int32)
+    tgt = box_encode(gt_boxes[best_gt], anchors, variances)
+    tgt = jnp.where(fg[:, None], tgt, 0.0)
+    return cls, tgt, fg, fg.sum()
+
+
+def _bilinear_sample(img, ys, xs):
+    """img (H, W, C); ys/xs float grids (any shape); zero outside."""
+    h, w, _ = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def gather(yi, xi):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        v = img[jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        return jnp.where(inb[..., None], v, 0.0)
+
+    yi0 = y0.astype(jnp.int32)
+    xi0 = x0.astype(jnp.int32)
+    return (gather(yi0, xi0) * ((1 - wy) * (1 - wx))[..., None]
+            + gather(yi0, xi0 + 1) * ((1 - wy) * wx)[..., None]
+            + gather(yi0 + 1, xi0) * (wy * (1 - wx))[..., None]
+            + gather(yi0 + 1, xi0 + 1) * (wy * wx)[..., None])
+
+
+@register_op("psroi_pool")
+def psroi_pool(features, rois, *, output_size=7, spatial_scale=1.0,
+               output_channels=None):
+    """Position-sensitive RoI pooling (psroi_pool_op, R-FCN): input
+    channels are k*k groups of D; bin (i, j) average-pools ONLY its own
+    group. features (H, W, k*k*D); rois (R, 4) xyxy image coords.
+    Returns (R, k, k, D)."""
+    k = output_size
+    h, w, c = features.shape
+    d = output_channels or c // (k * k)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+
+        def bin_ij(i, j):
+            y_lo = y1 + i * rh / k
+            y_hi = y1 + (i + 1) * rh / k
+            x_lo = x1 + j * rw / k
+            x_hi = x1 + (j + 1) * rw / k
+            m = ((ys[:, None] >= y_lo) & (ys[:, None] < y_hi)
+                 & (xs[None, :] >= x_lo) & (xs[None, :] < x_hi))
+            grp = jax.lax.dynamic_slice_in_dim(
+                features, (i * k + j) * d, d, axis=2)
+            s = (grp * m[..., None]).sum((0, 1))
+            return s / jnp.maximum(m.sum(), 1.0)
+
+        ii = jnp.arange(k)
+        return jax.vmap(lambda i: jax.vmap(
+            lambda j: bin_ij(i, j))(ii))(ii)      # (k, k, D)
+
+    return jax.vmap(one)(rois)
+
+
+@register_op("prroi_pool")
+def prroi_pool(features, rois, *, output_size=(7, 7), spatial_scale=1.0,
+               samples_per_bin=4):
+    """Precise RoI pooling (prroi_pool_op): continuous average of the
+    bilinear-interpolated feature over each bin. The reference evaluates
+    the exact integral; here the integral is approximated with a dense
+    ``samples_per_bin`` x ``samples_per_bin`` bilinear grid (converges to
+    the exact value, fully differentiable incl. w.r.t. roi coords)."""
+    oh, ow = output_size
+    sp = samples_per_bin
+
+    def one(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        bw = (x2 - x1) / ow
+        bh = (y2 - y1) / oh
+        ys = y1 + (jnp.arange(oh * sp) + 0.5) * bh / sp
+        xs = x1 + (jnp.arange(ow * sp) + 0.5) * bw / sp
+        grid = _bilinear_sample(features, ys[:, None] *
+                                jnp.ones_like(xs)[None, :],
+                                jnp.ones_like(ys)[:, None] * xs[None, :])
+        return grid.reshape(oh, sp, ow, sp, -1).mean((1, 3))
+
+    return jax.vmap(one)(rois)
+
+
+@register_op("deformable_conv")
+def deformable_conv(x, offset, weight, *, stride=1, padding=0,
+                    mask=None):
+    """Deformable conv v1/v2 (deformable_conv_op): each kernel tap samples
+    the input at its grid position + a learned (dy, dx) offset, bilinear-
+    interpolated; v2 additionally modulates each tap by ``mask``.
+    x (B, H, W, Cin); offset (B, Ho, Wo, 2*kh*kw) [dy, dx per tap];
+    weight (kh, kw, Cin, Cout); mask (B, Ho, Wo, kh*kw) or None.
+    Single group, NHWC (TPU layout; the reference is NCHW)."""
+    kh, kw, cin, cout = weight.shape
+    s = stride if isinstance(stride, tuple) else (stride, stride)
+    p = padding if isinstance(padding, tuple) else (padding, padding)
+    b, h, w, _ = x.shape
+    ho = (h + 2 * p[0] - kh) // s[0] + 1
+    wo = (w + 2 * p[1] - kw) // s[1] + 1
+    base_y = jnp.arange(ho) * s[0] - p[0]
+    base_x = jnp.arange(wo) * s[1] - p[1]
+
+    def one(img, off, msk):
+        taps = []
+        for i in range(kh):
+            for j in range(kw):
+                t = i * kw + j
+                dy = off[..., 2 * t]
+                dx = off[..., 2 * t + 1]
+                ys = base_y[:, None] + i + dy                  # (Ho, Wo)
+                xs = base_x[None, :] + j + dx
+                v = _bilinear_sample(img, ys, xs)              # (Ho,Wo,Cin)
+                if msk is not None:
+                    v = v * msk[..., t][..., None]
+                taps.append(v @ weight[i, j])                  # (Ho,Wo,Cout)
+        return sum(taps)
+
+    if mask is None:
+        return jax.vmap(lambda im, of: one(im, of, None))(x, offset)
+    return jax.vmap(one)(x, offset, mask)
+
+
+@register_op("generate_proposal_labels")
+def generate_proposal_labels(rois, roi_valid, gt_boxes, gt_labels,
+                             gt_mask, *, batch_size_per_im=64,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             variances=(0.1, 0.1, 0.2, 0.2), key=None):
+    """RCNN second-stage target sampling (generate_proposal_labels_op),
+    one image: label each proposal by max-IoU gt, subsample to
+    ``batch_size_per_im`` with ``fg_fraction`` foregrounds (deterministic
+    hardest-first unless ``key`` supplies random tie-break like the
+    reference), emit classification + regression targets. Returns
+    (labels (P,) int32 [-1 = not sampled], bbox_targets (P, 4),
+    fg_mask, bg_mask)."""
+    p = rois.shape[0]
+    iou = box_iou(gt_boxes, rois)
+    iou = jnp.where(gt_mask[:, None] & roi_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=0)
+    best_iou = jnp.max(iou, axis=0)
+    fg = best_iou >= fg_thresh
+    bg = (~fg) & (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo) \
+        & roi_valid
+    rand = (jax.random.uniform(key, (p,)) if key is not None
+            else jnp.zeros((p,)))
+    max_fg = int(batch_size_per_im * fg_fraction)
+    fg = topk_mask(fg, best_iou + rand, max_fg)
+    bg = topk_mask(bg, -best_iou + rand,
+                   batch_size_per_im - fg.sum())
+    labels = jnp.where(fg, gt_labels[best_gt],
+                       jnp.where(bg, 0, -1)).astype(jnp.int32)
+    tgt = box_encode(gt_boxes[best_gt], rois, variances)
+    tgt = jnp.where(fg[:, None], tgt, 0.0)
+    return labels, tgt, fg, bg

@@ -56,3 +56,34 @@ mod = _make("mod", jnp.mod, np.mod)
 max = _make("max", jnp.maximum, np.maximum)
 min = _make("min", jnp.minimum, np.minimum)
 pow = _make("pow", jnp.power, np.power)
+
+
+# ---------------------------------------------------------------------------
+# comparison + logical ops (operators/controlflow/compare_op.cc,
+# logical_op.cc — fluid surfaces them as layers.equal/less_than/...)
+# ---------------------------------------------------------------------------
+
+def _cmp(name, jfn, nfn):
+    @register_op(name, reference=nfn, has_grad=False)
+    def op(x, y, axis=-1):
+        return jfn(x, _align(x, y, axis))
+    op.__name__ = name
+    op.__doc__ = f"{name}_op: elementwise comparison, bool output."
+    return op
+
+
+equal = _cmp("equal", jnp.equal, np.equal)
+not_equal = _cmp("not_equal", jnp.not_equal, np.not_equal)
+less_than = _cmp("less_than", jnp.less, np.less)
+less_equal = _cmp("less_equal", jnp.less_equal, np.less_equal)
+greater_than = _cmp("greater_than", jnp.greater, np.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal, np.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and, np.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or, np.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor, np.logical_xor)
+
+
+@register_op("logical_not", reference=np.logical_not, has_grad=False)
+def logical_not(x):
+    """logical_not_op."""
+    return jnp.logical_not(x)

@@ -544,3 +544,375 @@ def bilinear_tensor_product(x, y, weight, bias=None):
     if bias is not None:
         out = out + bias
     return out
+
+
+# ---------------------------------------------------------------------------
+# loss long tail (mse_loss, dice_loss, bpr_loss, npair_loss, center_loss,
+# teacher_student_sigmoid_loss, sampled_softmax, nce, hsigmoid — fluid
+# layers/nn.py + loss_op family)
+# ---------------------------------------------------------------------------
+
+@register_op("mse_loss")
+def mse_loss(input, label):
+    """mse_loss: mean squared error."""
+    return jnp.mean((input - label) ** 2)
+
+
+@register_op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):
+    """dice_loss (segmentation): 1 - 2|X∩Y| / (|X|+|Y|). ``input`` (N, C)
+    probabilities, ``label`` (N,) int or (N, C) one-hot."""
+    if label.ndim == input.ndim - 1:
+        label = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = (input * label).sum(reduce_dims)
+    union = input.sum(reduce_dims) + label.sum(reduce_dims)
+    return (1.0 - (2.0 * inter + epsilon) / (union + epsilon)).mean()
+
+
+@register_op("bpr_loss")
+def bpr_loss(input, label):
+    """bpr_loss (Bayesian personalized ranking, session-based recs):
+    -mean log sigmoid(score[label] - score[j]) over the other columns.
+    ``input`` (N, C) scores, ``label`` (N,) int."""
+    n, c = input.shape
+    pos = jnp.take_along_axis(input, label[:, None], -1)      # (N, 1)
+    diff = pos - input                                        # (N, C)
+    logsig = jax.nn.log_sigmoid(diff)
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    return -(logsig * mask).sum() / (n * (c - 1))
+
+
+@register_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """npair_loss (metric learning): softmax CE over anchor·positiveᵀ
+    with same-label targets + L2 on embeddings."""
+    labels = labels.reshape(-1)
+    sim = anchor @ positive.T                                 # (N, N)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = same / same.sum(-1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, -1)
+    ce = -(targets * logp).sum(-1).mean()
+    l2 = (anchor ** 2).sum(-1).mean() + (positive ** 2).sum(-1).mean()
+    return ce + l2_reg * 0.25 * l2
+
+
+@register_op("center_loss")
+def center_loss(features, label, centers, alpha=0.1):
+    """center_loss_op: pull features toward per-class centers. Returns
+    (loss (N,), updated centers) — the reference updates centers in-place;
+    functionally the new centers come back to the caller."""
+    picked = centers[label]                                   # (N, D)
+    diff = features - picked
+    loss = 0.5 * (diff ** 2).sum(-1)
+    # center update: c_y -= alpha * mean over batch members of class y
+    counts = jnp.zeros((centers.shape[0],), features.dtype
+                       ).at[label].add(1.0)
+    sums_ = jnp.zeros_like(centers).at[label].add(diff)
+    new_centers = centers + alpha * sums_ / jnp.maximum(
+        counts[:, None], 1.0)
+    return loss, jax.lax.stop_gradient(new_centers)
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """teacher_student_sigmoid_loss_op (CTR distillation): log(1+exp(x)) -
+    x*z + sigmoid-CE against the teacher's soft score."""
+    x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    return sigmoid_cross_entropy_with_logits(x, label).mean()
+
+
+@register_op("sampled_softmax_with_cross_entropy", has_grad=True)
+def sampled_softmax_with_cross_entropy(logits_fn, label, key, *,
+                                       num_samples, num_classes):
+    """sampled_softmax_with_cross_entropy_op: CE over {true class} ∪
+    uniform negative samples. ``logits_fn(ids) -> (N, len(ids))`` computes
+    logits only for the sampled columns (the point of sampling: never
+    materialize the full vocab)."""
+    neg = jax.random.randint(key, (num_samples,), 0, num_classes)
+    ids = jnp.concatenate([label.reshape(-1), neg])            # (N + S,)
+    logits = logits_fn(ids)                                    # (N, N+S)
+    n = label.shape[0]
+    tgt = jnp.arange(n)                                        # true col i
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, tgt[:, None], -1).mean()
+
+
+@register_op("nce")
+def nce(emb, weight, bias, label, key, *, num_neg, num_classes):
+    """nce_op (noise-contrastive estimation, uniform noise): binary
+    logistic on the true class + ``num_neg`` uniform negatives.
+    ``emb`` (N, D); ``weight`` (C, D); ``bias`` (C,)."""
+    n = emb.shape[0]
+    neg = jax.random.randint(key, (n, num_neg), 0, num_classes)
+    pos_logit = (emb * weight[label]).sum(-1) + bias[label]    # (N,)
+    neg_logit = jnp.einsum("nd,nkd->nk", emb, weight[neg]) + bias[neg]
+    log_q = -jnp.log(float(num_classes))                       # uniform
+    pos = jax.nn.log_sigmoid(pos_logit - log_q)
+    negl = jax.nn.log_sigmoid(-(neg_logit - log_q)).sum(-1)
+    return -(pos + negl).mean()
+
+
+@register_op("hsigmoid")
+def hsigmoid(x, weight, bias, label, *, num_classes):
+    """hsigmoid_op (hierarchical sigmoid over the default complete binary
+    tree, like the reference's non-custom-tree path): the label's root-to-
+    leaf path is decoded from its binary representation; loss is the sum
+    of binary logistic losses at the (num_classes-1) internal nodes on
+    the path. ``weight`` (num_classes - 1, D); ``bias`` (num_classes-1,)."""
+    # complete-binary-tree paths: node ids 1..C-1 heap-style; leaf for
+    # class y is node (C + y); walk ancestors.
+    c = num_classes
+    depth = int(np.ceil(np.log2(c))) if c > 1 else 1
+    leaf = label + c                                           # (N,)
+    codes = []
+    nodes = []
+    cur = leaf
+    for _ in range(depth):
+        bit = cur % 2                                          # left/right
+        cur = cur // 2
+        nodes.append(cur)                                      # ancestor
+        codes.append(bit)
+    nodes = jnp.stack(nodes, -1)                               # (N, depth)
+    codes = jnp.stack(codes, -1).astype(x.dtype)
+    valid = nodes >= 1
+    idx = jnp.clip(nodes - 1, 0, c - 2)                        # weight row
+    logits = jnp.einsum("nd,nkd->nk", x, weight[idx]) + bias[idx]
+    # code 1 -> target 1, code 0 -> target 0 (sign convention of the op)
+    bce = sigmoid_cross_entropy_with_logits(logits, codes)
+    return (bce * valid).sum(-1).mean()
+
+
+# ---------------------------------------------------------------------------
+# normalization / misc nn tail
+# ---------------------------------------------------------------------------
+
+@register_op("data_norm")
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """data_norm_op (CTR): normalize by running sum statistics kept as
+    plain tensors (means the caller accumulates them — the reference
+    stores them as persistable params updated per batch). Returns
+    (normalized x, new_size, new_sum, new_square_sum)."""
+    mean = batch_sum / batch_size
+    var = batch_square_sum / batch_size - mean ** 2
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    n = x.shape[0]
+    return (out,
+            batch_size + n,
+            batch_sum + x.sum(0),
+            batch_square_sum + (x ** 2).sum(0))
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u, *, power_iters=1, epsilon=1e-12):
+    """spectral_norm_op: W / sigma_max(W) via power iteration. ``u``
+    (rows,) is the persistent left singular vector estimate; returns
+    (normalized weight, new_u)."""
+    w = weight.reshape(weight.shape[0], -1)
+
+    def it(u, _):
+        v = w.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), epsilon)
+        u = w @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), epsilon)
+        return u, v
+
+    u, v = jax.lax.scan(it, u, None, length=power_iters)
+    sigma = u @ w @ v[-1]          # scan stacks v: last iterate is v[-1]
+    return weight / sigma, jax.lax.stop_gradient(u)
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """add_position_encoding_op: x*alpha + beta*sinusoid (B, T, D)."""
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], -1)
+    return x * alpha + beta * pe[None, :, :].astype(x.dtype)
+
+
+@register_op("mean_iou", has_grad=False)
+def mean_iou(pred, label, num_classes):
+    """mean_iou_op: mean intersection-over-union over classes present."""
+    pred = pred.reshape(-1)
+    label = label.reshape(-1)
+    inter = jnp.zeros((num_classes,)).at[
+        jnp.where(pred == label, pred, num_classes - 1)].add(
+        (pred == label).astype(jnp.float32))
+    area_p = jnp.zeros((num_classes,)).at[pred].add(1.0)
+    area_l = jnp.zeros((num_classes,)).at[label].add(1.0)
+    union = area_p + area_l - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    return iou.sum() / jnp.maximum(present.sum(), 1)
+
+
+@register_op("row_conv")
+def row_conv(x, weight):
+    """row_conv_op (lookahead conv, Deep Speech 2): out[t] = sum_{k}
+    x[t+k] * w[k] with future context only. ``x`` (B, T, D); ``weight``
+    (K, D)."""
+    k = weight.shape[0]
+    pads = [(0, 0), (0, k - 1), (0, 0)]
+    xp = jnp.pad(x, pads)
+    return sum(xp[:, i:i + x.shape[1], :] * weight[i]
+               for i in range(k))
+
+
+@register_op("im2sequence", has_grad=True)
+def im2sequence(x, filter_size, stride=1, padding=0):
+    """im2sequence_op (OCR): slide a window over NHWC images; each window
+    flattens to one timestep. Returns (B, out_h*out_w, fh*fw*C)."""
+    fh, fw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (fh, fw), (sh, sw), ((ph, ph), (pw, pw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    b, oh, ow, _ = patches.shape
+    return patches.reshape(b, oh * ow, -1)
+
+
+@register_op("similarity_focus", has_grad=False)
+def similarity_focus(x, axis, indexes):
+    """similarity_focus_op: binary attention mask — for each selected
+    channel index along ``axis``, mark the argmax positions of every
+    other (row, col) slice. Simplified faithful variant: mask where the
+    selected slice attains its per-sample spatial max."""
+    masks = []
+    for idx in indexes:
+        sl = jax.lax.index_in_dim(x, idx, axis, keepdims=True)
+        spatial_axes = tuple(i for i in range(1, x.ndim) if i != axis)
+        m = sl == sl.max(axis=spatial_axes, keepdims=True)
+        masks.append(jnp.broadcast_to(m, x.shape))
+    out = masks[0]
+    for m in masks[1:]:
+        out = out | m
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv/pool family (conv3d_op, pool3d_op — video/volumetric)
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1):
+    """conv3d_op: NDHWC; weight DHWIO."""
+    stride = _triple(stride)
+    dilation = _triple(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pd, ph, pw = _triple(padding)
+        pad = ((pd, pd), (ph, ph), (pw, pw))
+    out = jax.lax.conv_general_dilated(
+        x, weight, stride, pad, rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0):
+    """conv3d_transpose_op via lhs dilation. Integer/tuple padding only
+    (string modes would silently mean something else here)."""
+    if isinstance(padding, str):
+        raise ValueError(
+            "conv3d_transpose takes integer/tuple padding, not "
+            f"{padding!r} (SAME/VALID are ambiguous for deconv)")
+    stride = _triple(stride)
+    pd, ph, pw = _triple(padding)
+    kd, kh, kw = weight.shape[:3]
+    pad = ((kd - 1 - pd, kd - 1 - pd), (kh - 1 - ph, kh - 1 - ph),
+           (kw - 1 - pw, kw - 1 - pw))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(weight, (0, 1, 2)),
+        (1, 1, 1), pad, lhs_dilation=stride,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("pool3d")
+def pool3d(x, kernel=2, stride=None, padding=0, pool_type="max"):
+    """pool3d_op: NDHWC max/avg pooling."""
+    kd, kh, kw = _triple(kernel)
+    stride = _triple(stride if stride is not None else kernel)
+    pd, ph, pw = _triple(padding)
+    dims = (1, kd, kh, kw, 1)
+    strides = (1,) + stride + (1,)
+    pads = ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))
+    if pool_type == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides,
+                                    pads)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                    pads)
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    dims, strides, pads)
+        out = out / cnt
+    return out
+
+
+@register_op("adaptive_pool3d")
+def adaptive_pool3d(x, output_size, pool_type="avg"):
+    """adaptive_pool3d_op: divisible sizes only (static shapes)."""
+    od, oh, ow = _triple(output_size)
+    b, d, h, w, c = x.shape
+    if d % od or h % oh or w % ow:
+        raise NotImplementedError(
+            "adaptive_pool3d needs divisible spatial dims on TPU "
+            f"(got {(d, h, w)} -> {(od, oh, ow)})")
+    xr = x.reshape(b, od, d // od, oh, h // oh, ow, w // ow, c)
+    if pool_type == "max":
+        return xr.max(axis=(2, 4, 6))
+    return xr.mean(axis=(2, 4, 6))
+
+
+# --- image-resize aliases (image_resize/resize_* fluid layers) ------------
+
+def resize_bilinear(x, size, data_format="NHWC"):
+    """resize_bilinear (bilinear_interp_op)."""
+    return interpolate(x, size, method="bilinear",
+                       data_format=data_format)
+
+
+def resize_nearest(x, size, data_format="NHWC"):
+    """resize_nearest (nearest_interp_op)."""
+    return interpolate(x, size, method="nearest",
+                       data_format=data_format)
+
+
+def image_resize(x, size, method="bilinear", data_format="NHWC"):
+    """layers.image_resize."""
+    return interpolate(x, size, method=method, data_format=data_format)
+
+
+def image_resize_short(x, short_len, method="bilinear"):
+    """layers.image_resize_short: scale so the short side == short_len."""
+    h, w = x.shape[1], x.shape[2]
+    if h <= w:
+        oh, ow = short_len, int(round(w * short_len / h))
+    else:
+        oh, ow = int(round(h * short_len / w)), short_len
+    return interpolate(x, (oh, ow), method=method)
+
+
+@register_op("resize_trilinear")
+def resize_trilinear(x, size):
+    """trilinear_interp_op: NDHWC volumetric resize."""
+    od, oh, ow = _triple(size) if not isinstance(size, tuple) else size
+    return jax.image.resize(
+        x, (x.shape[0], od, oh, ow, x.shape[4]), method="trilinear")

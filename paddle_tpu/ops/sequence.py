@@ -242,3 +242,78 @@ def make_segment_attention_bias(segment_ids, kv_segment_ids=None,
         kv_segment_ids = segment_ids
     same = segment_ids[:, :, None] == kv_segment_ids[:, None, :]
     return jnp.where(same, 0.0, -1e30).astype(dtype)[:, None, :, :]
+
+
+@register_op("sequence_first_step")
+def sequence_first_step(x, lengths):
+    """sequence_first_step (sequence_pool FIRST): (B, T, ...) -> (B, ...)."""
+    del lengths  # first step is index 0 regardless
+    return x[:, 0]
+
+
+@register_op("sequence_last_step")
+def sequence_last_step(x, lengths):
+    """sequence_last_step (sequence_pool LAST)."""
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(
+        x, idx.reshape(-1, *([1] * (x.ndim - 1))), axis=1)[:, 0]
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(x, ref_lengths, maxlen):
+    """sequence_expand_as_op: repeat row i of x ``ref_lengths[i]`` times
+    into a padded (B, maxlen, ...) layout (LoD -> padded analog)."""
+    out = jnp.repeat(x[:, None], maxlen, axis=1)
+    mask = jnp.arange(maxlen)[None, :] < ref_lengths[:, None]
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(
+        x.dtype)
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(x, lengths, new_dim):
+    """sequence_reshape_op: re-chunk each row's valid timesteps into
+    ``new_dim``-wide steps. Padded form: (B, T, D) -> (B, T*D//new_dim,
+    new_dim) with adjusted lengths (valid elements preserved)."""
+    b, t, d = x.shape
+    if (t * d) % new_dim:
+        raise ValueError(f"T*D={t*d} not divisible by new_dim={new_dim}")
+    out = x.reshape(b, t * d // new_dim, new_dim)
+    new_lengths = lengths * d // new_dim
+    return out, new_lengths
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, index, updates, lengths):
+    """sequence_scatter_op: per-row scatter-add of updates at index
+    positions (positions past lengths ignored)."""
+    b, k = index.shape
+    valid = jnp.arange(k)[None, :] < lengths[:, None]
+    upd = jnp.where(valid, updates, 0.0)
+
+    def one(row, idx, u):
+        return row.at[idx].add(u)
+
+    return jax.vmap(one)(x, index, upd)
+
+
+def dynamic_lstm(x, lengths, params, cell):
+    """layers.dynamic_lstm surface (dynamic_lstm_op): ragged-batch LSTM.
+    TPU-native form: the ``nn.rnn`` scan cells on padded rows + lengths
+    (the LoD analog) — ``cell`` is an ``nn.rnn.LSTMCell``-wrapped ``RNN``
+    layer, ``params`` its params."""
+    return cell(params, x, lengths)
+
+
+def dynamic_gru(x, lengths, params, cell):
+    """layers.dynamic_gru surface (dynamic_gru_op) — see dynamic_lstm."""
+    return cell(params, x, lengths)
+
+
+def lstm_unit(params, state, x, cell):
+    """layers.lstm_unit (lstm_unit_op): one LSTMCell step."""
+    return cell(params, state, x)
+
+
+def gru_unit(params, state, x, cell):
+    """layers.gru_unit (gru_unit_op): one GRUCell step."""
+    return cell(params, state, x)

@@ -401,3 +401,197 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     owner = x // shard_size
     local = x % shard_size
     return jnp.where(owner == shard_id, local, ignore_value)
+
+
+# ---------------------------------------------------------------------------
+# creation / shape-query tail (fill_constant_op.cc, scale_op.cc,
+# sign_op.cc, rank/size/sum surfaces of fluid layers/tensor.py)
+# ---------------------------------------------------------------------------
+
+@register_op("ones", reference=None, has_grad=False)
+def ones(shape, dtype=jnp.float32):
+    """layers.ones (fill_constant value=1)."""
+    return jnp.ones(shape, convert_dtype(dtype))
+
+
+@register_op("zeros", reference=None, has_grad=False)
+def zeros(shape, dtype=jnp.float32):
+    """layers.zeros (fill_constant value=0)."""
+    return jnp.zeros(shape, convert_dtype(dtype))
+
+
+@register_op("scale", reference=None)
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """scale_op: x*s + b (or (x+b)*s)."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("sign", reference=np.sign)
+def sign(x):
+    """sign_op."""
+    return jnp.sign(x)
+
+
+@register_op("rank", reference=None, has_grad=False)
+def rank(x):
+    """layers.rank: 0-d int tensor with the rank."""
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+@register_op("size", reference=None, has_grad=False)
+def size(x):
+    """size_op: total element count."""
+    return jnp.asarray(x.size, jnp.int64)
+
+
+@register_op("sum", reference=None)
+def sum_op(xs):
+    """sum_op: elementwise sum of a LIST of tensors (grad fan-out)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+sums = sum_op  # layers.sums alias
+
+
+@register_op("fill_constant_batch_size_like", reference=None,
+             has_grad=False)
+def fill_constant_batch_size_like(ref, shape, value, dtype=jnp.float32,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """fill_constant_batch_size_like_op: shape with one dim copied from a
+    reference tensor's batch dim."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(shape, value, convert_dtype(dtype))
+
+
+@register_op("gaussian_random_batch_size_like", reference=None,
+             has_grad=False)
+def gaussian_random_batch_size_like(ref, shape, key, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0):
+    """gaussian_random_batch_size_like_op (explicit PRNG key — TPU-native
+    randomness is functional, no global generator state)."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return mean + std * jax.random.normal(key, tuple(shape))
+
+
+@register_op("uniform_random_batch_size_like", reference=None,
+             has_grad=False)
+def uniform_random_batch_size_like(ref, shape, key, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0):
+    """uniform_random_batch_size_like_op."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jax.random.uniform(key, tuple(shape), minval=min, maxval=max)
+
+
+@register_op("reverse", reference=None)
+def reverse(x, axis):
+    """reverse_op: flip along the given axes."""
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis)
+
+
+@register_op("is_empty", reference=None, has_grad=False)
+def is_empty(x):
+    """is_empty_op."""
+    return jnp.asarray(x.size == 0)
+
+
+@register_op("has_inf", reference=None, has_grad=False)
+def has_inf(x):
+    """isfinite_op variant: any(|x| == inf)."""
+    return jnp.isinf(x).any()
+
+
+@register_op("has_nan", reference=None, has_grad=False)
+def has_nan(x):
+    """isfinite_op variant: any(x != x)."""
+    return jnp.isnan(x).any()
+
+
+@register_op("sampling_id", reference=None, has_grad=False)
+def sampling_id(probs, key):
+    """sampling_id_op: sample a column index per row of a probability
+    matrix (explicit key; reference uses a global generator)."""
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)),
+                                  axis=-1)
+
+
+@register_op("random_crop", reference=None, has_grad=False)
+def random_crop(x, crop_shape, key):
+    """random_crop_op: same random crop offsets for the whole batch dim 0
+    are NOT shared — per-sample offsets like the reference."""
+    b = x.shape[0]
+    ndim = len(crop_shape)
+    spatial = x.shape[1:1 + ndim]
+    keys = jax.random.split(key, b)
+
+    def one(img, k):
+        ks = jax.random.split(k, ndim)
+        starts = [jax.random.randint(ks[i], (), 0,
+                                     spatial[i] - crop_shape[i] + 1)
+                  for i in range(ndim)]
+        starts = starts + [0] * (img.ndim - ndim)
+        sizes = list(crop_shape) + list(img.shape[ndim:])
+        return jax.lax.dynamic_slice(img, starts, sizes)
+
+    return jax.vmap(one)(x, keys)
+
+
+@register_op("pad_constant_like", reference=None)
+def pad_constant_like(ref, x, pad_value=0.0):
+    """pad_constant_like_op: pad x up to ref's shape (trailing pads)."""
+    pads = [(0, r - s) for r, s in zip(ref.shape, x.shape)]
+    return jnp.pad(x, pads, constant_values=pad_value)
+
+
+@register_op("scatter_nd", reference=None)
+def scatter_nd(index, updates, shape):
+    """scatter_nd_op: zeros(shape) with updates added at index rows."""
+    out = jnp.zeros(shape, updates.dtype)
+    return out.at[tuple(index[..., i] for i in range(index.shape[-1]))
+                  ].add(updates)
+
+
+@register_op("unique_with_counts", reference=None, has_grad=False)
+def unique_with_counts(x, *, size=None):
+    """unique_with_counts_op. XLA needs static shapes: ``size`` bounds the
+    output (default len(x)); absent slots are filled with the first unique
+    value and zero counts."""
+    size = size or x.shape[0]
+    uniq, idx, counts = jnp.unique(x, return_inverse=True,
+                                   return_counts=True, size=size,
+                                   fill_value=x[0])
+    return uniq, idx, counts
+
+
+@register_op("hash", reference=None, has_grad=False)
+def hash_op(x, mod_by=100000007, num_hash=1):
+    """hash_op (Pyramid hash trick): deterministic int hashing of id
+    tensors into ``num_hash`` buckets spaces — multiplicative hashing
+    (knuth) instead of the reference's xxhash; same contract (stable,
+    spread), different constants."""
+    x = x.astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761)
+             + jnp.uint32((i * 0x9E3779B9) & 0xFFFFFFFF))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return outs[0] if num_hash == 1 else jnp.stack(outs, -1)
+
+
+def crop_tensor(x, shape, offsets=None):
+    """layers.crop_tensor (crop_tensor_op): static-offset crop."""
+    offsets = offsets or [0] * x.ndim
+    return jax.lax.slice(x, offsets,
+                         [o + s for o, s in zip(offsets, shape)])

@@ -1,0 +1,473 @@
+"""Second op-library long-tail batch: comparisons/logicals, creation ops,
+loss tail (dice/bpr/npair/center/nce/hsigmoid/sampled-softmax), 3-D
+conv/pool, resize aliases, sequence/array tail, detection composites,
+CTC greedy decode, in-graph edit distance. OpTest-style numpy parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import control_flow as CF
+from paddle_tpu.ops import crf as CRF
+from paddle_tpu.ops import detection as D
+from paddle_tpu.ops import elementwise as E
+from paddle_tpu.ops import nn as N
+from paddle_tpu.ops import sequence as S
+from paddle_tpu.ops import tensor as T
+
+
+class TestComparisons:
+    def test_all_comparisons_match_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        for ours, ref in [(E.equal, np.equal), (E.not_equal, np.not_equal),
+                          (E.less_than, np.less),
+                          (E.less_equal, np.less_equal),
+                          (E.greater_than, np.greater),
+                          (E.greater_equal, np.greater_equal)]:
+            np.testing.assert_array_equal(
+                np.asarray(ours(jnp.asarray(x), jnp.asarray(y))),
+                ref(x, y))
+        a = x > 0
+        b = y > 0
+        np.testing.assert_array_equal(
+            np.asarray(E.logical_and(jnp.asarray(a), jnp.asarray(b))),
+            a & b)
+        np.testing.assert_array_equal(
+            np.asarray(E.logical_xor(jnp.asarray(a), jnp.asarray(b))),
+            a ^ b)
+        np.testing.assert_array_equal(
+            np.asarray(E.logical_not(jnp.asarray(a))), ~a)
+
+
+class TestTensorTail:
+    def test_creation_and_queries(self):
+        assert T.ones((2, 3)).shape == (2, 3)
+        assert float(T.zeros((2,)).sum()) == 0.0
+        x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+        np.testing.assert_allclose(np.asarray(T.scale(x, 2.0, 1.0)),
+                                   np.arange(6).reshape(2, 3) * 2 + 1)
+        assert int(T.rank(x)) == 2 and int(T.size(x)) == 6
+        np.testing.assert_allclose(
+            np.asarray(T.sum_op([x, x, x])), 3 * np.asarray(x))
+        f = T.fill_constant_batch_size_like(x, [7, 4], 5.0)
+        assert f.shape == (2, 4) and float(f[0, 0]) == 5.0
+        np.testing.assert_allclose(np.asarray(T.reverse(x, 1)),
+                                   np.asarray(x)[:, ::-1])
+        assert not bool(T.is_empty(x))
+        assert not bool(T.has_nan(x)) and not bool(T.has_inf(x))
+        assert bool(T.has_nan(jnp.asarray([np.nan])))
+
+    def test_scatter_nd_and_unique(self):
+        idx = jnp.asarray([[0], [2], [0]])
+        upd = jnp.asarray([1.0, 2.0, 3.0])
+        out = np.asarray(T.scatter_nd(idx, upd, (4,)))
+        np.testing.assert_allclose(out, [4.0, 0.0, 2.0, 0.0])
+        u, inv, cnt = T.unique_with_counts(jnp.asarray([3, 1, 3, 2]))
+        assert set(np.asarray(u).tolist()) >= {1, 2, 3}
+        np.testing.assert_array_equal(
+            np.asarray(u)[np.asarray(inv)], [3, 1, 3, 2])
+
+    def test_hash_stable_and_spread(self):
+        ids = jnp.arange(1000, dtype=jnp.int64)
+        h1 = np.asarray(T.hash_op(ids, mod_by=997))
+        h2 = np.asarray(T.hash_op(ids, mod_by=997))
+        np.testing.assert_array_equal(h1, h2)
+        assert len(np.unique(h1)) > 500        # spreads
+        h3 = np.asarray(T.hash_op(ids, mod_by=997, num_hash=3))
+        assert h3.shape == (1000, 3)
+
+    def test_pad_constant_like_and_random(self):
+        ref = jnp.zeros((3, 4))
+        x = jnp.ones((2, 2))
+        out = np.asarray(T.pad_constant_like(ref, x, -1.0))
+        assert out.shape == (3, 4)
+        assert out[2, 3] == -1.0 and out[0, 0] == 1.0
+        key = jax.random.PRNGKey(0)
+        g = T.gaussian_random_batch_size_like(ref, [9, 5], key)
+        assert g.shape == (3, 5)
+        u = T.uniform_random_batch_size_like(ref, [9, 5], key, 0.0, 1.0)
+        assert float(u.min()) >= 0.0
+        s = T.sampling_id(jnp.asarray([[0.0, 1.0, 0.0]]), key)
+        assert int(s[0]) == 1
+        crop = T.random_crop(jnp.ones((2, 8, 8, 3)), (4, 4), key)
+        assert crop.shape == (2, 4, 4, 3)
+
+
+class TestLossTail:
+    def test_mse_dice(self):
+        x = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        lab = jnp.asarray([0, 1])
+        assert float(N.mse_loss(jnp.ones((3,)), jnp.zeros((3,)))) == 1.0
+        d = float(N.dice_loss(x, lab))
+        d_bad = float(N.dice_loss(x, jnp.asarray([1, 0])))
+        assert d < d_bad
+
+    def test_bpr_and_npair(self):
+        scores = jnp.asarray([[5.0, 0.0, 0.0], [0.0, 5.0, 0.0]])
+        good = float(N.bpr_loss(scores, jnp.asarray([0, 1])))
+        bad = float(N.bpr_loss(scores, jnp.asarray([1, 0])))
+        assert good < bad
+        anchor = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+        lab = jnp.asarray([0, 1])
+        ln = float(N.npair_loss(anchor, anchor, lab))
+        assert np.isfinite(ln)
+
+    def test_center_loss_moves_centers(self):
+        feats = jnp.asarray([[1.0, 1.0], [3.0, 3.0]])
+        labels = jnp.asarray([0, 0])
+        centers = jnp.zeros((2, 2))
+        loss, new_c = N.center_loss(feats, labels, centers, alpha=0.5)
+        assert loss.shape == (2,)
+        np.testing.assert_allclose(np.asarray(new_c)[0], [1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(new_c)[1], [0.0, 0.0])
+
+    def test_hsigmoid_and_nce_descend(self):
+        rng = np.random.RandomState(0)
+        n, d, c = 16, 8, 10
+        x = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, c, (n,)))
+        w = jnp.asarray(rng.randn(c - 1, d).astype(np.float32) * 0.1)
+        b = jnp.zeros((c - 1,))
+        loss_fn = lambda w_, b_: N.hsigmoid(x, w_, b_, labels,
+                                            num_classes=c)
+        l0 = float(loss_fn(w, b))
+        for _ in range(20):
+            gw, gb = jax.grad(loss_fn, argnums=(0, 1))(w, b)
+            w, b = w - 0.5 * gw, b - 0.5 * gb
+        assert float(loss_fn(w, b)) < l0 * 0.8
+
+        wn = jnp.asarray(rng.randn(c, d).astype(np.float32) * 0.1)
+        bn = jnp.zeros((c,))
+        key = jax.random.PRNGKey(0)
+        nce_fn = lambda w_: N.nce(x, w_, bn, labels, key, num_neg=4,
+                                  num_classes=c)
+        n0 = float(nce_fn(wn))
+        for _ in range(10):
+            wn = wn - 0.3 * jax.grad(nce_fn)(wn)
+        assert float(nce_fn(wn)) < n0
+
+    def test_sampled_softmax(self):
+        rng = np.random.RandomState(1)
+        n, d, c = 4, 8, 100
+        emb = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        table = jnp.asarray(rng.randn(c, d).astype(np.float32))
+        labels = jnp.asarray([3, 7, 11, 13])
+        loss = N.sampled_softmax_with_cross_entropy(
+            lambda ids: emb @ table[ids].T, labels,
+            jax.random.PRNGKey(0), num_samples=20, num_classes=c)
+        assert np.isfinite(float(loss))
+
+    def test_teacher_student(self):
+        x = jnp.asarray([0.0, 2.0, -2.0])
+        z = jax.nn.sigmoid(x)
+        near = float(N.teacher_student_sigmoid_loss(x, z))
+        far = float(N.teacher_student_sigmoid_loss(x, 1.0 - z))
+        assert near < far
+
+
+class TestNNTail:
+    def test_data_norm(self):
+        x = jnp.asarray([[1.0], [3.0]])
+        out, n, s, sq = N.data_norm(x, 2.0, jnp.asarray([4.0]),
+                                    jnp.asarray([10.0]))
+        # mean=2, var=10/2-4=1 -> normalized = [-1, 1]
+        np.testing.assert_allclose(np.asarray(out)[:, 0], [-1.0, 1.0],
+                                   rtol=1e-3)
+        assert float(n) == 4.0 and float(s[0]) == 8.0
+
+    def test_spectral_norm_unit_sigma(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(6, 4).astype(np.float32))
+        u = jnp.ones((6,)) / np.sqrt(6)
+        wn, u = N.spectral_norm(w, u, power_iters=20)
+        sigma = np.linalg.svd(np.asarray(wn), compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+    def test_add_position_encoding(self):
+        x = jnp.zeros((1, 4, 8))
+        out = np.asarray(N.add_position_encoding(x))
+        assert out.shape == (1, 4, 8)
+        # position 0: sin(0)=0, cos(0)=1
+        np.testing.assert_allclose(out[0, 0, :4], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out[0, 0, 4:], 1.0, atol=1e-6)
+
+    def test_mean_iou_perfect_and_half(self):
+        p = jnp.asarray([0, 1, 1, 0])
+        assert float(N.mean_iou(p, p, 2)) == pytest.approx(1.0)
+        half = float(N.mean_iou(p, jnp.asarray([0, 1, 0, 1]), 2))
+        assert 0.0 < half < 1.0
+
+    def test_row_conv_lookahead_only(self):
+        x = jnp.asarray(np.eye(4, dtype=np.float32)[None, :, :])
+        w = jnp.asarray([[1.0] * 4, [0.5] * 4])
+        out = np.asarray(N.row_conv(x, w))
+        # out[t] = x[t] + 0.5 x[t+1]: strictly future context
+        np.testing.assert_allclose(out[0, 0], [1.0, 0.5, 0.0, 0.0])
+        np.testing.assert_allclose(out[0, 3], [0.0, 0.0, 0.0, 1.0])
+
+    def test_im2sequence(self):
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+        seq = np.asarray(N.im2sequence(x, 2, stride=2))
+        assert seq.shape == (1, 4, 4)
+        np.testing.assert_allclose(seq[0, 0], [0, 1, 4, 5])
+
+    def test_conv3d_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1, 4, 4, 4, 2).astype(np.float32))
+        w = jnp.asarray(rng.randn(2, 2, 2, 2, 3).astype(np.float32))
+        out = N.conv3d(x, w)
+        assert out.shape == (1, 3, 3, 3, 3)
+        manual = (np.asarray(x)[0, :2, :2, :2, :, None]
+                  * np.asarray(w)).sum((0, 1, 2, 3))
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], manual,
+                                   rtol=1e-4)
+
+    def test_conv3d_transpose_shape_roundtrip(self):
+        x = jnp.ones((1, 3, 3, 3, 2))
+        w = jnp.ones((2, 2, 2, 2, 4))
+        out = N.conv3d_transpose(x, w, stride=2)
+        assert out.shape[1] == 2 * 3 + (2 - 2)  # (D-1)*s + k = 6
+
+    def test_pool3d_and_adaptive(self):
+        x = jnp.arange(8, dtype=jnp.float32).reshape(1, 2, 2, 2, 1)
+        mx = float(N.pool3d(x, 2)[0, 0, 0, 0, 0])
+        assert mx == 7.0
+        avg = float(N.pool3d(x, 2, pool_type="avg")[0, 0, 0, 0, 0])
+        assert avg == 3.5
+        ad = N.adaptive_pool3d(jnp.ones((1, 4, 4, 4, 2)), 2)
+        assert ad.shape == (1, 2, 2, 2, 2)
+        with pytest.raises(NotImplementedError):
+            N.adaptive_pool3d(jnp.ones((1, 5, 4, 4, 2)), 2)
+
+    def test_resize_aliases(self):
+        x = jnp.ones((1, 4, 6, 3))
+        assert N.resize_bilinear(x, (8, 12)).shape == (1, 8, 12, 3)
+        assert N.resize_nearest(x, 2).shape == (1, 2, 2, 3)
+        short = N.image_resize_short(x, 2)
+        assert short.shape == (1, 2, 3, 3)
+        v = jnp.ones((1, 2, 4, 4, 1))
+        assert N.resize_trilinear(v, (4, 8, 8)).shape == (1, 4, 8, 8, 1)
+
+
+class TestSequenceTail:
+    def test_first_last_step(self):
+        x = jnp.arange(12, dtype=jnp.float32).reshape(2, 3, 2)
+        lengths = jnp.asarray([3, 2])
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_first_step(x, lengths)),
+            np.asarray(x)[:, 0])
+        last = np.asarray(S.sequence_last_step(x, lengths))
+        np.testing.assert_allclose(last[0], np.asarray(x)[0, 2])
+        np.testing.assert_allclose(last[1], np.asarray(x)[1, 1])
+
+    def test_expand_as_and_reshape(self):
+        x = jnp.asarray([[1.0], [2.0]])
+        out = np.asarray(S.sequence_expand_as(x, jnp.asarray([3, 1]), 4))
+        np.testing.assert_allclose(out[0, :, 0], [1, 1, 1, 0])
+        np.testing.assert_allclose(out[1, :, 0], [2, 0, 0, 0])
+        y = jnp.arange(12, dtype=jnp.float32).reshape(1, 3, 4)
+        r, ln = S.sequence_reshape(y, jnp.asarray([2]), 2)
+        assert r.shape == (1, 6, 2)
+        assert int(ln[0]) == 4
+
+    def test_sequence_scatter(self):
+        x = jnp.zeros((2, 5))
+        idx = jnp.asarray([[0, 2], [1, 4]])
+        upd = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        out = np.asarray(S.sequence_scatter(x, idx, upd,
+                                            jnp.asarray([2, 1])))
+        np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+        np.testing.assert_allclose(out[1], [0, 3, 0, 0, 0])  # 2nd ignored
+
+
+class TestArrays:
+    def test_array_layer_roundtrip(self):
+        arr = CF.create_array(3, jnp.zeros((2,)))
+        arr = CF.array_write(arr, 0, jnp.asarray([1.0, 2.0]))
+        arr = CF.array_write(arr, 2, jnp.asarray([5.0, 6.0]))
+        assert CF.array_length(arr) == 3
+        np.testing.assert_allclose(np.asarray(CF.array_read(arr, 0)),
+                                   [1.0, 2.0])
+        stacked = CF.tensor_array_to_tensor(arr)
+        assert stacked.shape == (3, 2)
+        cat = CF.tensor_array_to_tensor(arr, axis=1)
+        assert cat.shape == (6,)
+
+
+class TestDetectionComposites:
+    def test_detection_output_shapes(self):
+        rng = np.random.RandomState(0)
+        p, c, b = 16, 4, 2
+        anchors = jnp.asarray(
+            np.sort(rng.rand(p, 2, 2), axis=1).reshape(p, 4).astype(
+                np.float32))
+        loc = jnp.asarray(rng.randn(b, p, 4).astype(np.float32) * 0.1)
+        conf = jnp.asarray(rng.randn(b, p, c).astype(np.float32))
+        boxes, cls, scores, valid = D.detection_output(
+            loc, conf, anchors, keep_top_k=10)
+        assert boxes.shape[0] == b and boxes.shape[2] == 4
+        v = np.asarray(valid)
+        assert v.any()
+        cl = np.asarray(cls)[v]
+        assert ((cl >= 1) & (cl < c)).all()   # background never returned
+
+    def test_multiclass_nms2_returns_indices(self):
+        boxes = jnp.asarray([[0, 0, 1, 1], [5, 5, 6, 6]], jnp.float32)
+        scores = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        cls, idxs, valid, idx2 = D.multiclass_nms2(boxes, scores,
+                                                   max_per_class=2)
+        np.testing.assert_array_equal(np.asarray(idxs), np.asarray(idx2))
+
+    def test_box_decoder_and_assign(self):
+        anchors = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        deltas = jnp.zeros((1, 8))        # 2 classes x 4
+        scores = jnp.asarray([[0.2, 0.8]])
+        decoded, assigned = D.box_decoder_and_assign(anchors, deltas,
+                                                     scores)
+        assert decoded.shape == (1, 2, 4)
+        np.testing.assert_allclose(np.asarray(assigned),
+                                   np.asarray(decoded)[:, 1], rtol=1e-6)
+
+    def test_retinanet_target_assign(self):
+        anchors = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30],
+                               [100, 100, 110, 110]], jnp.float32)
+        gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        cls, tgt, fg, n_fg = D.retinanet_target_assign(
+            anchors, gt, jnp.asarray([3]), jnp.asarray([True]))
+        lab = np.asarray(cls)
+        assert lab[0] == 3 and lab[1] == 0 and lab[2] == 0
+        assert int(n_fg) == 1
+
+
+class TestCTCDecodeAndEditDistance:
+    def test_greedy_decoder_merges_and_drops(self):
+        # frames: a a blank a b b -> "a a b" (merge repeats per segment)
+        ids = [1, 1, 0, 1, 2, 2]
+        probs = jax.nn.one_hot(jnp.asarray([ids]), 3)
+        toks, lens = CRF.ctc_greedy_decoder(probs, jnp.asarray([6]))
+        assert int(lens[0]) == 3
+        np.testing.assert_array_equal(np.asarray(toks)[0, :3], [1, 1, 2])
+
+    def test_edit_distance_op_matches_host_metric(self):
+        from paddle_tpu.metrics import EditDistance as HostED
+        rng = np.random.RandomState(0)
+        b, l1, l2 = 4, 7, 6
+        hyp = rng.randint(1, 5, (b, l1))
+        ref = rng.randint(1, 5, (b, l2))
+        hl = np.array([7, 5, 3, 1])
+        rl = np.array([6, 6, 2, 4])
+        out = np.asarray(CRF.edit_distance(
+            jnp.asarray(hyp), jnp.asarray(hl), jnp.asarray(ref),
+            jnp.asarray(rl), normalized=False))
+        for i in range(b):
+            want = HostED.levenshtein(hyp[i, :hl[i]], ref[i, :rl[i]])
+            assert out[i] == pytest.approx(want), i
+
+
+class TestRCNNTail:
+    def test_psroi_pool_groups(self):
+        # k=2, D=1: 4 channel groups; group g is constant g+1
+        k, d, h, w = 2, 1, 8, 8
+        feats = jnp.stack([jnp.full((h, w), g + 1.0)
+                           for g in range(k * k)], -1)
+        rois = jnp.asarray([[0.0, 0.0, 8.0, 8.0]])
+        out = np.asarray(D.psroi_pool(feats, rois, output_size=2))
+        # bin (i, j) pools only group i*k+j -> value i*k+j+1
+        np.testing.assert_allclose(out[0, :, :, 0],
+                                   [[1.0, 2.0], [3.0, 4.0]], rtol=1e-5)
+
+    def test_prroi_pool_constant_field(self):
+        feats = jnp.full((8, 8, 3), 2.5)
+        rois = jnp.asarray([[1.2, 1.7, 6.3, 6.9]])   # non-integer coords
+        out = np.asarray(D.prroi_pool(feats, rois, output_size=(2, 2)))
+        np.testing.assert_allclose(out, 2.5, rtol=1e-4)
+
+    def test_prroi_differentiable_wrt_rois(self):
+        rng = np.random.RandomState(0)
+        feats = jnp.asarray(rng.randn(8, 8, 2).astype(np.float32))
+        g = jax.grad(lambda r: D.prroi_pool(feats, r).sum())(
+            jnp.asarray([[1.0, 1.0, 6.0, 6.0]]))
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_deformable_conv_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1, 6, 6, 3).astype(np.float32))
+        wgt = jnp.asarray(rng.randn(3, 3, 3, 4).astype(np.float32))
+        off = jnp.zeros((1, 4, 4, 2 * 9))
+        out = D.deformable_conv(x, off, wgt)
+        ref = jax.lax.conv_general_dilated(
+            x, wgt, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_deformable_conv_mask_scales(self):
+        x = jnp.ones((1, 4, 4, 1))
+        wgt = jnp.ones((1, 1, 1, 1))
+        off = jnp.zeros((1, 4, 4, 2))
+        half = 0.5 * jnp.ones((1, 4, 4, 1))
+        out = D.deformable_conv(x, off, wgt, mask=half)
+        np.testing.assert_allclose(np.asarray(out), 0.5, rtol=1e-6)
+
+    def test_generate_proposal_labels(self):
+        rois = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60], [49, 49, 61, 61]],
+                           jnp.float32)
+        valid = jnp.ones((4,), bool)
+        gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        labels, tgt, fg, bg = D.generate_proposal_labels(
+            rois, valid, gt, jnp.asarray([5]), jnp.asarray([True]),
+            batch_size_per_im=4, fg_fraction=0.5)
+        lab = np.asarray(labels)
+        assert lab[0] == 5                 # IoU 1.0 -> fg with gt class
+        assert (lab[2:] == 0).all()        # far rois -> background
+        assert np.abs(np.asarray(tgt)[~np.asarray(fg)]).sum() == 0
+
+    def test_py_func_callback(self):
+        from paddle_tpu.ops.control_flow import py_func
+
+        def host_fn(a):
+            return np.asarray(a) * 2.0
+
+        @jax.jit
+        def traced(x):
+            return py_func(host_fn, (x,),
+                           jax.ShapeDtypeStruct((3,), jnp.float32))
+
+        np.testing.assert_allclose(np.asarray(traced(jnp.ones(3))), 2.0)
+
+    def test_crop_tensor(self):
+        x = jnp.arange(24, dtype=jnp.float32).reshape(4, 6)
+        out = np.asarray(T.crop_tensor(x, (2, 3), (1, 2)))
+        np.testing.assert_allclose(out, np.arange(24).reshape(4, 6)
+                                   [1:3, 2:5])
+
+
+class TestReviewFixes2:
+    def test_spectral_norm_default_iters(self):
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(5, 3).astype(np.float32))
+        u = jnp.ones((5,)) / np.sqrt(5)
+        wn, _ = N.spectral_norm(w, u)          # power_iters=1 default
+        assert wn.shape == w.shape
+
+    def test_conv3d_transpose_rejects_string_padding(self):
+        with pytest.raises(ValueError):
+            N.conv3d_transpose(jnp.ones((1, 2, 2, 2, 1)),
+                               jnp.ones((2, 2, 2, 1, 1)), padding="SAME")
+
+    def test_detection_output_crowded_single_class(self):
+        # 30 well-separated boxes of ONE class: keep_top_k=20 must return
+        # 20 of them, not keep_top_k // C
+        n = 30
+        centers = np.arange(n) * 10.0
+        anchors = np.stack([centers, centers, centers + 5.0,
+                            centers + 5.0], -1).astype(np.float32)
+        loc = jnp.zeros((1, n, 4))
+        conf = jnp.zeros((1, n, 3)).at[:, :, 1].set(5.0)
+        boxes, cls, scores, valid = D.detection_output(
+            loc, conf, jnp.asarray(anchors / 300.0), keep_top_k=20)
+        assert int(np.asarray(valid).sum()) == 20
