@@ -96,3 +96,53 @@ def program_to_dot(fn, *example_args, max_nodes=200, **example_kwargs):
                      f'more ops", style=dashed];')
     lines.append("}")
     return "\n".join(lines)
+
+
+def op_frequency(fn, *example_args, **example_kwargs):
+    """Count primitive frequencies in a traced program
+    (``contrib/op_frequence.py`` parity): {primitive_name: count},
+    sorted dict by descending count."""
+    import collections
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs).jaxpr
+    counts = collections.Counter()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                # nested programs hide in single params (scan's "jaxpr")
+                # AND in tuples of them (cond's "branches")
+                for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr)
+        return counts
+
+    walk(jaxpr)
+    return dict(sorted(counts.items(), key=lambda kv: -kv[1]))
+
+
+def estimate_memory(fn, *example_args, **example_kwargs):
+    """Peak-memory / traffic estimate for a jitted function
+    (``contrib/memory_usage_calc.py`` parity, but from the compiler
+    itself): returns {"argument_bytes", "output_bytes",
+    "temp_bytes", "generated_code_bytes", "total_bytes"} from XLA's
+    compiled memory analysis — the authoritative number, not a
+    shape-walk approximation."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*example_args, **example_kwargs).compile()
+    m = compiled.memory_analysis()
+    if m is None:                                  # backend w/o analysis
+        return None
+    out = {
+        "argument_bytes": int(getattr(m, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(m, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(m, "temp_size_in_bytes", 0)),
+        "generated_code_bytes": int(
+            getattr(m, "generated_code_size_in_bytes", 0)),
+    }
+    out["total_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                          + out["temp_bytes"])
+    return out

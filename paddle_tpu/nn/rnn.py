@@ -49,6 +49,41 @@ class LSTMCell(Layer):
         return (h, c), h
 
 
+class LSTMPCell(Layer):
+    """LSTM with a recurrent projection (dynamic_lstmp_op): cell state is
+    ``hidden_size`` wide but the recurrent/output state is projected down
+    to ``proj_size`` — the large-vocab speech/LM configuration."""
+
+    def __init__(self, input_size, hidden_size, proj_size,
+                 forget_bias=1.0):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.proj_size = proj_size
+        self.w = self.create_parameter(
+            "w", (input_size + proj_size, 4 * hidden_size),
+            initializer=I.xavier_uniform(), sharding=P(None, "tp"))
+        self.b = self.create_parameter("b", (4 * hidden_size,),
+                                       initializer=I.zeros)
+        self.proj = self.create_parameter(
+            "proj", (hidden_size, proj_size),
+            initializer=I.xavier_uniform(), sharding=P("tp", None))
+        self.forget_bias = forget_bias
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.proj_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def forward(self, params, state, x):
+        r, c = state
+        gates = jnp.concatenate([x, r], -1) @ params["w"] + params["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + self.forget_bias) * c \
+            + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        r = h @ params["proj"]
+        return (r, c), r
+
+
 class GRUCell(Layer):
     def __init__(self, input_size, hidden_size):
         super().__init__()

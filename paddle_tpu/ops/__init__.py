@@ -6,8 +6,8 @@ pattern (op_registry.h:199) without global constructors.
 """
 
 from paddle_tpu.ops import (activation, attention, crf, detection,
-                            elementwise, math, nn, reduction, sequence,
-                            tensor)
+                            elementwise, math, metrics_ops, nn,
+                            reduction, sequence, tensor)
 from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
                                       flash_attention,
                                       scaled_dot_product_attention)

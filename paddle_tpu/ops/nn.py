@@ -635,6 +635,12 @@ def sampled_softmax_with_cross_entropy(logits_fn, label, key, *,
     logits = logits_fn(ids)                                    # (N, N+S)
     n = label.shape[0]
     tgt = jnp.arange(n)                                        # true col i
+    # remove accidental hits (reference remove_accidental_hits=True):
+    # any column whose id equals the row's true label, other than the
+    # row's own column, must not appear in the denominator
+    hit = (ids[None, :] == label.reshape(-1)[:, None]) & \
+        (jnp.arange(ids.shape[0])[None, :] != tgt[:, None])
+    logits = jnp.where(hit, -jnp.inf, logits)
     logp = jax.nn.log_softmax(logits, -1)
     return -jnp.take_along_axis(logp, tgt[:, None], -1).mean()
 

@@ -277,8 +277,13 @@ def sequence_reshape(x, lengths, new_dim):
     b, t, d = x.shape
     if (t * d) % new_dim:
         raise ValueError(f"T*D={t*d} not divisible by new_dim={new_dim}")
+    # per-row validity (the reference raises per sequence; raising on
+    # data-dependent values is impossible under jit): rows whose
+    # lengths*d is not divisible by new_dim get length -1 as an explicit
+    # in-band error the caller must check — never a silent truncation
+    divisible = (lengths * d) % new_dim == 0
+    new_lengths = jnp.where(divisible, lengths * d // new_dim, -1)
     out = x.reshape(b, t * d // new_dim, new_dim)
-    new_lengths = lengths * d // new_dim
     return out, new_lengths
 
 

@@ -442,8 +442,10 @@ def rank(x):
 
 @register_op("size", reference=None, has_grad=False)
 def size(x):
-    """size_op: total element count."""
-    return jnp.asarray(x.size, jnp.int64)
+    """size_op: total element count (int32 unless x64 is enabled — JAX
+    truncates int64 silently otherwise)."""
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(x.size, dt)
 
 
 @register_op("sum", reference=None)
@@ -586,7 +588,8 @@ def hash_op(x, mod_by=100000007, num_hash=1):
         h = h ^ (h >> 16)
         h = h * jnp.uint32(0x85EBCA6B)
         h = h ^ (h >> 13)
-        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+        dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        outs.append((h % jnp.uint32(mod_by)).astype(dt))
     return outs[0] if num_hash == 1 else jnp.stack(outs, -1)
 
 

@@ -212,3 +212,83 @@ class TestReviewRegressions:
 
     def _qat_setup(self):
         return TestQAT._setup(self)
+
+
+class TestDebugTools:
+    def test_op_frequency(self):
+        from paddle_tpu.debug import op_frequency
+        f = lambda x: jnp.tanh(x @ x).sum()
+        freq = op_frequency(f, jnp.ones((4, 4)))
+        assert freq["dot_general"] == 1 and freq["tanh"] == 1
+
+    def test_op_frequency_nested(self):
+        from paddle_tpu.debug import op_frequency
+
+        def f(x):
+            return jax.lax.scan(lambda c, _: (jnp.tanh(c), None), x,
+                                None, length=3)[0]
+
+        freq = op_frequency(f, jnp.ones((4,)))
+        assert freq.get("tanh", 0) >= 1     # found inside the scan body
+
+    def test_estimate_memory(self):
+        from paddle_tpu.debug import estimate_memory
+        m = estimate_memory(lambda x: (x @ x).sum(), jnp.ones((8, 8)))
+        if m is not None:                   # backend-dependent
+            assert m["argument_bytes"] == 8 * 8 * 4
+            assert m["total_bytes"] > 0
+
+
+class TestLSTMP:
+    def test_projection_shapes_and_training(self):
+        from paddle_tpu.nn.rnn import LSTMPCell, RNN
+        cell = LSTMPCell(input_size=6, hidden_size=16, proj_size=4)
+        rnn = RNN(cell)
+        params = rnn.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 6),
+                        jnp.float32)
+        out, final = rnn(params, x)
+        assert out.shape == (2, 5, 4)       # projected width
+        r, c = final
+        assert r.shape == (2, 4) and c.shape == (2, 16)
+        g = jax.grad(lambda p: rnn(p, x)[0].sum())(params)
+        flat = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(a)).all() for a in flat)
+
+
+class TestInGraphMetricOps:
+    def test_auc_matches_host_metric(self):
+        from paddle_tpu.metrics import Auc
+        from paddle_tpu.ops.metrics_ops import auc
+        rng = np.random.RandomState(0)
+        probs = rng.rand(500).astype(np.float32)
+        labels = (probs + 0.3 * rng.randn(500) > 0.5).astype(np.float32)
+        host = Auc(num_thresholds=511)
+        host.update(probs, labels)
+        k = 511
+        a, pb, nb = jax.jit(auc)(jnp.asarray(probs), jnp.asarray(labels),
+                                 jnp.zeros(k + 1), jnp.zeros(k + 1))
+        assert float(a) == pytest.approx(host.eval(), abs=0.02)
+
+    def test_auc_streaming_accumulates(self):
+        from paddle_tpu.ops.metrics_ops import auc
+        pb = nb = jnp.zeros(101)
+        # perfect separation over two updates -> auc ~ 1
+        a, pb, nb = auc(jnp.asarray([0.9, 0.1]), jnp.asarray([1.0, 0.0]),
+                        pb, nb)
+        a, pb, nb = auc(jnp.asarray([0.8, 0.2]), jnp.asarray([1.0, 0.0]),
+                        pb, nb)
+        assert float(a) > 0.95
+        assert float(pb.sum()) == 2 and float(nb.sum()) == 2
+
+    def test_precision_recall_stream(self):
+        from paddle_tpu.ops.metrics_ops import precision_recall
+        stats = jnp.zeros(3)
+        (p, r, f1), stats = precision_recall(
+            jnp.asarray([0.9, 0.8, 0.2]), jnp.asarray([1.0, 0.0, 1.0]),
+            stats)
+        assert float(p) == pytest.approx(0.5)
+        assert float(r) == pytest.approx(0.5)
+        (p2, r2, _), stats = precision_recall(
+            jnp.asarray([0.9]), jnp.asarray([1.0]), stats)
+        assert float(stats[0]) == 2.0     # tp accumulated

@@ -471,3 +471,34 @@ class TestReviewFixes2:
         boxes, cls, scores, valid = D.detection_output(
             loc, conf, jnp.asarray(anchors / 300.0), keep_top_k=20)
         assert int(np.asarray(valid).sum()) == 20
+
+
+class TestReviewFixes3:
+    def test_sequence_reshape_flags_indivisible_rows(self):
+        x = jnp.arange(12, dtype=jnp.float32).reshape(1, 4, 3)
+        _, ln = S.sequence_reshape(x, jnp.asarray([1]), 2)  # 3 % 2 != 0
+        assert int(ln[0]) == -1
+        _, ln2 = S.sequence_reshape(x, jnp.asarray([2]), 2)
+        assert int(ln2[0]) == 3
+
+    def test_sampled_softmax_removes_accidental_hits(self):
+        # 2 rows, same true label; a perfect model must reach ~0 loss
+        d, c = 4, 10
+        emb = jnp.asarray([[10.0, 0, 0, 0], [10.0, 0, 0, 0]])
+        table = jnp.zeros((c, d)).at[3, 0].set(1.0)   # class 3 aligned
+        labels = jnp.asarray([3, 3])
+        loss = float(N.sampled_softmax_with_cross_entropy(
+            lambda ids: emb @ table[ids].T, labels,
+            jax.random.PRNGKey(0), num_samples=8, num_classes=c))
+        assert loss < 0.05     # duplicate label columns masked out
+
+    def test_op_frequency_sees_cond_branches(self):
+        from paddle_tpu.debug import op_frequency
+
+        def f(x):
+            return jax.lax.cond(x.sum() > 0,
+                                lambda y: jnp.sin(y),
+                                lambda y: jnp.tanh(y), x)
+
+        freq = op_frequency(f, jnp.ones((3,)))
+        assert freq.get("sin", 0) >= 1 and freq.get("tanh", 0) >= 1
