@@ -10,8 +10,8 @@ from paddle_tpu.nn.transformer import (FeedForward, MultiHeadAttention,
                                        TransformerDecoderLayer,
                                        TransformerEncoderLayer)
 from paddle_tpu.nn.moe import MoEFeedForward
-from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, RNN,
-                               SimpleRNNCell)
+from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, LSTMPCell,
+                               RNN, SimpleRNNCell)
 
 __all__ = [
     "initializer", "Layer", "LayerList", "ParamSpec", "Sequential",
@@ -20,6 +20,6 @@ __all__ = [
     "Linear", "Pool2D",
     "FeedForward", "MultiHeadAttention", "TransformerDecoderLayer",
     "TransformerEncoderLayer",
-    "MoEFeedForward", "BiRNN", "GRUCell", "LSTM", "LSTMCell", "RNN",
-    "SimpleRNNCell",
+    "MoEFeedForward", "BiRNN", "GRUCell", "LSTM", "LSTMCell", "LSTMPCell",
+    "RNN", "SimpleRNNCell",
 ]

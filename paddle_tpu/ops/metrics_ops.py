@@ -31,7 +31,9 @@ def auc(probs, labels, pos_bins, neg_bins):
     fpr = fp / tot_n
     area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) * 0.5)
     area = area + fpr[0] * tpr[0] * 0.5          # first trapezoid from 0
-    return area, pos_bins, neg_bins
+    # single-class history is "no information" — 0.5, like metrics.Auc
+    degenerate = (pos_bins.sum() == 0) | (neg_bins.sum() == 0)
+    return jnp.where(degenerate, 0.5, area), pos_bins, neg_bins
 
 
 @register_op("precision_recall", has_grad=False)

@@ -292,3 +292,15 @@ class TestInGraphMetricOps:
         (p2, r2, _), stats = precision_recall(
             jnp.asarray([0.9]), jnp.asarray([1.0]), stats)
         assert float(stats[0]) == 2.0     # tp accumulated
+
+
+class TestAucDegenerate:
+    def test_single_class_history_is_half(self):
+        from paddle_tpu.ops.metrics_ops import auc
+        a, pb, nb = auc(jnp.asarray([0.2, 0.4]), jnp.asarray([0.0, 0.0]),
+                        jnp.zeros(65), jnp.zeros(65))
+        assert float(a) == 0.5
+
+    def test_lstmp_public_export(self):
+        from paddle_tpu.nn import LSTMPCell
+        assert LSTMPCell is not None
