@@ -109,6 +109,28 @@ class CompiledProgram:
         return self._fn(state, **feeds)
 
 
+def _dataset_batches(dataset, batch_size, feed_builder, drop_last=False):
+    """Iterate batches from either a native MultiSlotDataset (its
+    ``batches`` stream) or a python reader creator (callable yielding
+    samples, batched here). Reader creators REQUIRE ``feed_builder`` —
+    the Executor feeds keyword dicts, not raw sample lists."""
+    if hasattr(dataset, "batches"):
+        yield from dataset.batches(batch_size)
+        return
+    if feed_builder is None:
+        raise ValueError(
+            "reader-creator datasets need feed_builder(samples) -> feed "
+            "dict (native MultiSlotDataset batches pass through as-is)")
+    buf = []
+    for sample in dataset():
+        buf.append(sample)
+        if len(buf) == batch_size:
+            yield feed_builder(buf)
+            buf = []
+    if buf and not drop_last:
+        yield feed_builder(buf)      # trailing partial batch is NOT lost
+
+
 class Executor:
     """Feed/fetch runner (fluid Executor parity: run(program, feed, fetch)).
 
@@ -147,5 +169,44 @@ class Executor:
             fetches = jax.tree_util.tree_map(np.asarray, jax.device_get(fetches))
         return state, fetches
 
+    def train_from_dataset(self, program, dataset, state, *,
+                           batch_size=64, epochs=1, feed_builder=None,
+                           fetch_handler=None):
+        """Dataset-path training (fluid executor.py:1101
+        ``train_from_dataset`` → ``Executor::RunFromDataset``,
+        executor.cc:168): run ``program`` over every batch of ``dataset``
+        for ``epochs``. The reference spawns device-worker threads pulling
+        parsed records from the DataFeed channel; here the native feed (or
+        a reader creator) streams host batches into one jitted program —
+        XLA owns the device parallelism. ``feed_builder(samples) -> feed``
+        adapts raw reader samples; ``fetch_handler(step, fetches)``
+        observes results (PrintFetchVars parity). Returns (state, last
+        fetches)."""
+        fetches = None
+        step_i = 0
+        for _ in range(epochs):
+            # training drops the ragged tail (a different batch shape
+            # would trigger a recompile for one step per epoch)
+            for batch in _dataset_batches(dataset, batch_size,
+                                          feed_builder, drop_last=True):
+                state, fetches = self.run(program, state, feed=batch,
+                                          return_numpy=False)
+                if fetch_handler is not None:
+                    fetch_handler(step_i, fetches)
+                step_i += 1
+        return state, fetches
+
+    def infer_from_dataset(self, program, dataset, state, *,
+                           batch_size=64, feed_builder=None):
+        """Forward-only dataset pass (fluid infer_from_dataset parity):
+        collects per-batch fetches into a list."""
+        outs = []
+        for batch in _dataset_batches(dataset, batch_size, feed_builder):
+            _, fetches = self.run(program, state, feed=batch,
+                                  return_numpy=True)
+            outs.append(fetches)
+        return outs
+
     def close(self):
         self._cache.clear()
+

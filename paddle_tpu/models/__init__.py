@@ -16,10 +16,11 @@ from paddle_tpu.models.vgg import VGG, VGG16
 from paddle_tpu.models.se_resnext import SEResNeXt, SEResNeXt50
 from paddle_tpu.models.ssd import SSD, SSDConfig
 from paddle_tpu.models.faster_rcnn import FasterRCNN, FasterRCNNConfig
+from paddle_tpu.models.video import C3D, TSN
 
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
            "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
            "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
-           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig"]
+           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN"]

@@ -100,7 +100,7 @@ class FasterRCNN(Layer):
 
     def _head(self, params, feat_i, rois):
         pooled = D.roi_align(
-            feat_i, rois / 1.0,
+            feat_i, rois,
             output_size=(self.cfg.roi_size, self.cfg.roi_size),
             spatial_scale=feat_i.shape[0] / self.cfg.image_size)
         flat = pooled.reshape(rois.shape[0], -1)
@@ -123,7 +123,8 @@ class FasterRCNN(Layer):
         def one(feat_i, score_i, delta_i, gt_b, gt_l, gt_m):
             # --- RPN losses
             labels, tgt, fg, bg = D.rpn_target_assign(
-                anchors, gt_b, gt_m, batch_size_per_im=cfg.rpn_batch)
+                anchors, gt_b, gt_m, im_shape=im_shape,
+                batch_size_per_im=cfg.rpn_batch)
             obj = ops_nn.sigmoid_cross_entropy_with_logits(
                 score_i, (labels == 1).astype(score_i.dtype))
             used = labels >= 0
@@ -141,7 +142,6 @@ class FasterRCNN(Layer):
             rois = jax.lax.stop_gradient(rois)
             # mix in gt boxes as guaranteed-quality proposals (reference
             # generate_proposal_labels does the same)
-            g = gt_b.shape[0]
             rois = jnp.concatenate([rois, gt_b])
             valid = jnp.concatenate([valid, gt_m])
             roi_labels, roi_tgt, roi_fg, roi_bg = \
@@ -196,23 +196,22 @@ class FasterRCNN(Layer):
             probs = jax.nn.softmax(cls_logits.astype(jnp.float32), -1)
             probs = probs * valid[:, None]
             reg = reg.reshape(rois.shape[0], cfg.num_classes, 4)
-            # decode per-class boxes; class 0 = background dropped
+            # decode per-class boxes; class 0 = background dropped.
+            # Per-class NMS (multiclass_nms) — one flat NMS would let
+            # overlapping objects of DIFFERENT classes suppress each other
             boxes_c = jax.vmap(
                 lambda dc: D.box_clip(D.box_decode(dc, rois), im_shape),
                 in_axes=1, out_axes=1)(reg)       # (R, C, 4)
-            flat_boxes = boxes_c[:, 1:].reshape(-1, 4)
-            flat_scores = probs[:, 1:].reshape(-1)
-            cls_of = jnp.tile(jnp.arange(1, cfg.num_classes),
-                              (rois.shape[0],))
-            k = min(max_per_class * (cfg.num_classes - 1),
-                    flat_scores.shape[0])
-            top_s, order = jax.lax.top_k(
-                jnp.where(flat_scores >= score_threshold, flat_scores,
-                          -jnp.inf), k)
-            cand = flat_boxes[order]
-            idxs, ok = D.nms(cand, top_s, iou_threshold=nms_threshold,
-                             max_outputs=k)
-            return (cand[idxs], cls_of[order][idxs],
-                    jnp.where(ok, top_s[idxs], 0.0), ok)
+            # multiclass_nms shares one box set across classes: use the
+            # per-roi best-foreground-class decoded box as that set
+            best_c = jnp.argmax(probs[:, 1:], axis=-1) + 1
+            cand = jnp.take_along_axis(
+                boxes_c, best_c[:, None, None].repeat(4, -1), 1)[:, 0]
+            cls_ids, idxs, ok = D.multiclass_nms(
+                cand, probs[:, 1:], iou_threshold=nms_threshold,
+                score_threshold=score_threshold,
+                max_per_class=max_per_class)
+            sel = jnp.where(ok, probs[idxs, cls_ids + 1], 0.0)
+            return cand[idxs], cls_ids + 1, sel, ok
 
         return jax.vmap(one)(feat, scores, deltas)

@@ -1074,3 +1074,56 @@ def generate_proposal_labels(rois, roi_valid, gt_boxes, gt_labels,
     tgt = box_encode(gt_boxes[best_gt], rois, variances)
     tgt = jnp.where(fg[:, None], tgt, 0.0)
     return labels, tgt, fg, bg
+
+
+@register_op("roi_perspective_transform")
+def roi_perspective_transform(features, rois, *, output_size=(8, 8),
+                              spatial_scale=1.0):
+    """roi_perspective_transform_op (EAST OCR): rectify quadrilateral
+    RoIs into fixed (oh, ow) patches via a per-RoI homography + bilinear
+    sampling. ``features`` (H, W, C); ``rois`` (R, 8) quad corners
+    (x1,y1,...,x4,y4) in clockwise order starting top-left, image
+    coords. Differentiable w.r.t. features AND roi corners."""
+    oh, ow = output_size
+
+    def homography(quad):
+        """Solve the 8-dof projective map sending the output rect's
+        corners (0,0),(ow-1,0),(ow-1,oh-1),(0,oh-1) to the quad."""
+        src = jnp.asarray([[0.0, 0.0], [ow - 1.0, 0.0],
+                           [ow - 1.0, oh - 1.0], [0.0, oh - 1.0]])
+        dst = quad.reshape(4, 2)
+        rows = []
+        rhs = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.stack([sx, sy, 1.0, 0.0, 0.0, 0.0,
+                                   -sx * dx, -sy * dx]))
+            rows.append(jnp.stack([0.0, 0.0, 0.0, sx, sy, 1.0,
+                                   -sx * dy, -sy * dy]))
+            rhs.extend([dx, dy])
+        A = jnp.stack(rows)
+        b = jnp.stack(rhs)
+        # Tikhonov guard: predicted quads can degenerate (collinear /
+        # repeated corners) making A singular — a NaN here would poison
+        # the whole loss; the epsilon is invisible for valid quads
+        A = A + 1e-6 * jnp.eye(8)
+        h = jnp.linalg.solve(A, b)
+        return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+    gy, gx = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                          jnp.arange(ow, dtype=jnp.float32),
+                          indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1)         # (oh, ow, 3)
+
+    def one(quad):
+        H = homography(quad * spatial_scale)
+        mapped = grid @ H.T                            # (oh, ow, 3)
+        xs = mapped[..., 0] / jnp.maximum(jnp.abs(mapped[..., 2]),
+                                          1e-8) * jnp.sign(mapped[..., 2])
+        ys = mapped[..., 1] / jnp.maximum(jnp.abs(mapped[..., 2]),
+                                          1e-8) * jnp.sign(mapped[..., 2])
+        return _bilinear_sample(features, ys, xs)
+
+    return jax.vmap(one)(rois)

@@ -72,3 +72,26 @@ class TestFasterRCNN:
             batch_size_per_im=4)
         assert int(np.asarray(fg).sum()) == 1
         assert int(np.asarray(labels)[4]) == 2
+
+
+class TestDetectPerClass:
+    def test_overlapping_different_classes_both_survive(self):
+        # per-class NMS: two classes on the same box must BOTH come out
+        from paddle_tpu.ops import detection as D
+        boxes = jnp.asarray([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5]],
+                            jnp.float32)
+        scores = jnp.asarray([[0.9, 0.05], [0.05, 0.8]])
+        cls_ids, idxs, valid = D.multiclass_nms(
+            boxes, scores, iou_threshold=0.5, score_threshold=0.1,
+            max_per_class=2)
+        kept = set(zip(np.asarray(cls_ids)[np.asarray(valid)].tolist(),
+                       np.asarray(idxs)[np.asarray(valid)].tolist()))
+        assert (0, 0) in kept and (1, 1) in kept
+
+    def test_degenerate_quad_no_nan(self):
+        from paddle_tpu.ops import detection as D
+        feats = jnp.ones((8, 8, 1))
+        quad = jnp.zeros((1, 8))           # all corners identical
+        out = D.roi_perspective_transform(feats, quad,
+                                          output_size=(2, 2))
+        assert np.isfinite(np.asarray(out)).all()

@@ -193,3 +193,44 @@ class TestDetectionMetrics:
         cm = CompositeMetric(Accuracy(), Accuracy())
         cm.update(np.array([1, 0]), np.array([1, 1]))
         assert cm.eval() == [0.5, 0.5]
+
+
+class TestVideoModels:
+    def _video(self, b=2, frames=8, s=16, classes=4, seed=0):
+        rng = np.random.RandomState(seed)
+        return dict(
+            video=jnp.asarray(rng.randn(b, frames, s, s, 3).astype(
+                np.float32)),
+            label=jnp.asarray(rng.randint(0, classes, (b,))))
+
+    def test_tsn_consensus_and_train(self):
+        from paddle_tpu.models.video import TSN
+        model = TSN(num_classes=4, num_segments=3, scale=0.125)
+        batch = dict(self._video(frames=3))
+        params = model.init(jax.random.PRNGKey(0))
+        logits = model(params, batch["video"])
+        assert logits.shape == (2, 4)
+        _train_smoke(model, batch, steps=6,
+                     optimizer=opt.Adam(learning_rate=1e-3))
+
+    def test_tsn_consensus_is_segment_mean(self):
+        from paddle_tpu.models.video import TSN
+        model = TSN(num_classes=3, num_segments=2, scale=0.125)
+        params = model.init(jax.random.PRNGKey(0))
+        v = jnp.asarray(np.random.RandomState(1).randn(1, 2, 16, 16, 3),
+                        jnp.float32)
+        full = model(params, v)
+        per = [model.backbone(params["backbone"], v[:, i])
+               for i in range(2)]
+        np.testing.assert_allclose(np.asarray(full),
+                                   np.asarray((per[0] + per[1]) / 2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_c3d_train(self):
+        from paddle_tpu.models.video import C3D
+        model = C3D(num_classes=4, width_scale=0.125)
+        batch = self._video(frames=8)
+        params = model.init(jax.random.PRNGKey(0))
+        assert model(params, batch["video"]).shape == (2, 4)
+        _train_smoke(model, batch, steps=6,
+                     optimizer=opt.Adam(learning_rate=1e-3))

@@ -81,6 +81,15 @@ def _sample_args(name):
         "hinge_loss": (randn(4, 1),
                        RNG.randint(0, 2, (4, 1)).astype(np.float32)),
     }
+    if name in ("equal", "not_equal", "less_than", "less_equal",
+                "greater_than", "greater_equal"):
+        return (randn(4, 6), randn(4, 6))
+    if name in ("logical_and", "logical_or", "logical_xor"):
+        return (x > 0, randn(4, 6) > 0)
+    if name == "logical_not":
+        return (x > 0,)
+    if name in ("acos", "asin"):
+        return (np.clip(x, -0.99, 0.99),)
     if name.startswith("elementwise_"):
         return (randn(4, 6), randn(4, 6))
     if name.startswith("reduce_") or name in ("logsumexp",):

@@ -502,3 +502,33 @@ class TestReviewFixes3:
 
         freq = op_frequency(f, jnp.ones((3,)))
         assert freq.get("sin", 0) >= 1 and freq.get("tanh", 0) >= 1
+
+
+class TestRoiPerspective:
+    def test_axis_aligned_quad_matches_resize(self):
+        # axis-aligned quad == plain crop+resize of the feature map
+        feats = jnp.asarray(
+            np.arange(64, dtype=np.float32).reshape(8, 8, 1))
+        quad = jnp.asarray([[2.0, 2.0, 5.0, 2.0, 5.0, 5.0, 2.0, 5.0]])
+        out = np.asarray(D.roi_perspective_transform(
+            feats, quad, output_size=(4, 4)))
+        # corners of the output must hit the quad corners (up to the
+        # Tikhonov guard's ~1e-6 relative perturbation)
+        np.testing.assert_allclose(out[0, 0, 0, 0], feats[2, 2, 0],
+                                   rtol=1e-3)
+        np.testing.assert_allclose(out[0, 0, 3, 0], feats[2, 5, 0],
+                                   rtol=1e-3)
+        np.testing.assert_allclose(out[0, 3, 3, 0], feats[5, 5, 0],
+                                   rtol=1e-3)
+
+    def test_rotated_quad_and_grads(self):
+        feats = jnp.asarray(np.random.RandomState(0).randn(10, 10, 2),
+                            jnp.float32)
+        quad = jnp.asarray([[5.0, 1.0, 9.0, 5.0, 5.0, 9.0, 1.0, 5.0]])
+        out = D.roi_perspective_transform(feats, quad,
+                                          output_size=(4, 4))
+        assert out.shape == (1, 4, 4, 2)
+        g = jax.grad(lambda q: D.roi_perspective_transform(
+            feats, q, output_size=(4, 4)).sum())(quad)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
