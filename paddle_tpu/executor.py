@@ -115,7 +115,7 @@ def _dataset_batches(dataset, batch_size, feed_builder, drop_last=False):
     samples, batched here). Reader creators REQUIRE ``feed_builder`` —
     the Executor feeds keyword dicts, not raw sample lists."""
     if hasattr(dataset, "batches"):
-        yield from dataset.batches(batch_size)
+        yield from dataset.batches(batch_size, drop_last=drop_last)
         return
     if feed_builder is None:
         raise ValueError(

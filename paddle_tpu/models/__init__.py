@@ -17,10 +17,11 @@ from paddle_tpu.models.se_resnext import SEResNeXt, SEResNeXt50
 from paddle_tpu.models.ssd import SSD, SSDConfig
 from paddle_tpu.models.faster_rcnn import FasterRCNN, FasterRCNNConfig
 from paddle_tpu.models.video import C3D, TSN
+from paddle_tpu.models.yolov3 import YOLOv3, YOLOv3Config
 
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
            "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
            "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
-           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN"]
+           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN", "YOLOv3", "YOLOv3Config"]

@@ -49,10 +49,11 @@ class _Conv3DBN(Layer):
             initializer=I.msra_normal(fan_in=fan_in))
         self.bn = BatchNorm(out_ch)
         self.stride = stride
+        self.padding = tuple(k // 2 for k in kd)   # shape-preserving
 
     def forward(self, params, x, training=False):
         y = ops_nn.conv3d(x, params["weight"], stride=self.stride,
-                          padding=1)
+                          padding=self.padding)
         # BatchNorm normalizes the trailing channel dim; NDHWC folds the
         # depth axis into the spatial dims it already averages over
         b, d, h, w, c = y.shape

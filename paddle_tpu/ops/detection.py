@@ -1127,3 +1127,37 @@ def roi_perspective_transform(features, rois, *, output_size=(8, 8),
         return _bilinear_sample(features, ys, xs)
 
     return jax.vmap(one)(rois)
+
+
+@register_op("generate_mask_labels")
+def generate_mask_labels(rois, match_gt, fg_mask, gt_masks, *,
+                         resolution=14, im_size):
+    """Mask-RCNN mask targets (generate_mask_labels_op.cc): for each
+    foreground RoI, crop its matched ground-truth instance mask to the
+    RoI window and resample to (resolution, resolution), thresholded to
+    {0, 1}. The reference rasterizes COCO polygons then crops; here the
+    gt arrives as binary masks (G, Hm, Wm) at image scale (the
+    rasterization lives in the data pipeline).
+
+    rois (R, 4) pixel xyxy; match_gt (R,) gt index per roi; fg_mask (R,)
+    marks rois that get mask supervision. Returns (targets (R, res, res)
+    float 0/1 — zero rows for non-fg, weights (R,))."""
+    _, mh, mw = gt_masks.shape
+    if mh != mw:
+        # roi_align has one spatial_scale; anisotropic rasters would
+        # sample the x axis wrongly — rescale rois per-axis instead
+        raise ValueError(
+            f"gt_masks must be square rasters, got {(mh, mw)}; "
+            "resample masks (or store at image aspect) upstream")
+    scale = mh / im_size
+
+    def one(roi, gi, fg):
+        m = gt_masks[gi][:, :, None].astype(jnp.float32)   # (Hm, Wm, 1)
+        patch = roi_align(m, roi[None],
+                          output_size=(resolution, resolution),
+                          spatial_scale=scale)[0, :, :, 0]
+        return jnp.where(fg, (patch >= 0.5).astype(jnp.float32),
+                         jnp.zeros_like(patch))
+
+    targets = jax.vmap(one)(rois, jnp.maximum(match_gt, 0), fg_mask)
+    return targets, fg_mask.astype(jnp.float32)
