@@ -18,10 +18,13 @@ from paddle_tpu.models.ssd import SSD, SSDConfig
 from paddle_tpu.models.faster_rcnn import FasterRCNN, FasterRCNNConfig
 from paddle_tpu.models.video import C3D, TSN
 from paddle_tpu.models.yolov3 import YOLOv3, YOLOv3Config
+from paddle_tpu.models.ocr import CRNN
+from paddle_tpu.models.gan import (DCGANDiscriminator, DCGANGenerator,
+                                   gan_step)
 
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
            "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
            "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
-           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN", "YOLOv3", "YOLOv3Config"]
+           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN", "YOLOv3", "YOLOv3Config", "CRNN", "DCGANGenerator", "DCGANDiscriminator", "gan_step"]

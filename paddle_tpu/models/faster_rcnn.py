@@ -62,9 +62,7 @@ class FasterRCNN(Layer):
         self.backbone = MobileNetV1(num_classes=1,
                                     scale=cfg.backbone_scale)
         self._endpoint = 10               # stride-16 feature map
-        def c(ch):
-            return max(8, int(ch * cfg.backbone_scale))
-        feat_ch = c(self.backbone.CFG[self._endpoint][0])
+        feat_ch = self.backbone.block_channels[self._endpoint]
         a = len(cfg.anchor_sizes) * len(cfg.aspect_ratios)
         self.num_anchors = a
         self.rpn_conv = Conv2D(feat_ch, cfg.head_dim, 3, padding=1)

@@ -45,9 +45,11 @@ class MobileNetV1(Layer):
         self.stem = ConvBNLayer(in_ch, c(32), 3, stride=2, act="relu")
         blocks = []
         prev = c(32)
+        self.block_channels = []   # per-block output widths (for heads)
         for out, stride in self.CFG:
             blocks.append(_DepthwiseSeparable(prev, c(out), stride))
             prev = c(out)
+            self.block_channels.append(prev)
         self.blocks = LayerList(blocks)
         self.out_ch = prev
         self.fc = Linear(prev, num_classes,

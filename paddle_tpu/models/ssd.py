@@ -57,10 +57,8 @@ class SSD(Layer):
             i if i >= 0 else len(self.backbone.blocks) - 1
             for i in cfg.endpoints)
 
-        # probe backbone channel widths statically
-        def c(ch):
-            return max(8, int(ch * cfg.backbone_scale))
-        widths = [c(out) for out, _ in self.backbone.CFG]
+        # backbone publishes its per-block widths — no re-derivation
+        widths = self.backbone.block_channels
         level_ch = [widths[i] for i in self._endpoints]
 
         extras = []

@@ -8,7 +8,7 @@ yolo_box_op) over a DarkNet body."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,9 +57,7 @@ class YOLOv3(Layer):
         self._endpoints = tuple(i if i >= 0 else n_blocks - 1
                                 for i in cfg.endpoints)
 
-        def c(ch):
-            return max(8, int(ch * cfg.backbone_scale))
-        widths = [c(out) for out, _ in self.backbone.CFG]
+        widths = self.backbone.block_channels
         heads, necks = [], []
         for lvl, ep in enumerate(self._endpoints):
             in_ch = widths[ep]
@@ -89,9 +87,10 @@ class YOLOv3(Layer):
         del key
         cfg = self.cfg
         heads = self.forward(params, image, training=training)
+        img_w = image.shape[2]                 # NHWC: derive from input
         total = 0.0
         for lvl, head in enumerate(heads):
-            downsample = cfg.image_size // head.shape[-1]
+            downsample = img_w // head.shape[-1]
             total = total + D.yolov3_loss(
                 head, gt_boxes, gt_labels, gt_mask,
                 anchors=cfg.anchors,
@@ -106,11 +105,12 @@ class YOLOv3(Layer):
         """-> per image (boxes (K, 4) pixel xyxy, cls, scores, valid)."""
         cfg = self.cfg
         heads = self.forward(params, image, training=False)
-        b = image.shape[0]
-        img_size = jnp.full((b, 2), cfg.image_size, jnp.int32)
+        b, img_h, img_w = image.shape[0], image.shape[1], image.shape[2]
+        img_size = jnp.tile(jnp.asarray([[img_h, img_w]], jnp.int32),
+                            (b, 1))
         all_boxes, all_scores = [], []
         for lvl, head in enumerate(heads):
-            downsample = cfg.image_size // head.shape[-1]
+            downsample = img_w // head.shape[-1]
             anchors_lvl = [cfg.anchors[i] for i in cfg.anchor_masks[lvl]]
             boxes, scores = D.yolo_box(
                 head, img_size, anchors_lvl, cfg.num_classes,
