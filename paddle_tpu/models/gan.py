@@ -11,7 +11,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear
-from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.nn.module import (Layer, LayerList, apply_state_updates,
+                                  capture_state)
 from paddle_tpu.ops import nn as ops_nn
 
 
@@ -48,13 +49,20 @@ class DCGANGenerator(Layer):
 
 
 class DCGANDiscriminator(Layer):
+    """Input must be (4 * 2^n_down) square — the mirror of the
+    generator's s = 4 * 2^n_up output (asserted in forward)."""
+
     def __init__(self, in_ch=1, base=32, n_down=3):
         super().__init__()
+        self._in_size = 4 * (2 ** n_down)
         convs, bns = [], []
         ch_in = in_ch
         ch = base
         for i in range(n_down):
+            # bias only on the first conv: the following BatchNorm's
+            # mean-subtraction cancels any bias (ConvBNLayer convention)
             convs.append(Conv2D(ch_in, ch, 4, stride=2, padding=1,
+                                bias=(i == 0),
                                 weight_init=I.normal(std=0.02)))
             if i > 0:
                 bns.append(BatchNorm(ch))
@@ -65,6 +73,10 @@ class DCGANDiscriminator(Layer):
         self.fc = Linear(ch_in * 4 * 4, 1, sharding=None)
 
     def forward(self, params, x, training=False):
+        if x.shape[1] != self._in_size or x.shape[2] != self._in_size:
+            raise ValueError(
+                f"discriminator expects {self._in_size}x{self._in_size} "
+                f"inputs (4 * 2^n_down), got {x.shape[1]}x{x.shape[2]}")
         for i, conv in enumerate(self.convs):
             x = conv(params["convs"][str(i)], x)
             if i > 0:
@@ -79,39 +91,53 @@ def gan_step(gen, disc, g_opt, d_opt):
     (g_state, d_state, metrics)`` doing one discriminator update (real
     vs fake, non-saturating BCE) then one generator update."""
 
+    # BN running stats ride the state tape exactly like build_train_step:
+    # each loss returns (loss, tape-updates) and the updated params get
+    # the new stats merged back — inference-mode forwards then normalize
+    # with genuinely trained statistics
+
+    # tape scoping: paths are model-relative, so gen and disc tapes MUST
+    # be captured separately (their "bns/0/mean" keys collide); each
+    # model's stats update only on ITS optimization step
+
     def d_loss(d_params, g_params, real, z):
-        fake = gen(g_params, z, training=True)
-        r = disc(d_params, real, training=True)
-        f = disc(d_params, jax.lax.stop_gradient(fake), training=True)
+        with capture_state():                 # throwaway: gen stats
+            fake = gen(g_params, z, training=True)
+        with capture_state() as tape:
+            r = disc(d_params, real, training=True)
+            f = disc(d_params, jax.lax.stop_gradient(fake),
+                     training=True)
         bce = ops_nn.sigmoid_cross_entropy_with_logits
-        return (bce(r, jnp.ones_like(r)).mean()
+        loss = (bce(r, jnp.ones_like(r)).mean()
                 + bce(f, jnp.zeros_like(f)).mean())
+        return loss, dict(tape.updates)
 
     def g_loss(g_params, d_params, z):
-        fake = gen(g_params, z, training=True)
-        f = disc(d_params, fake, training=True)
-        return ops_nn.sigmoid_cross_entropy_with_logits(
+        with capture_state() as tape:
+            fake = gen(g_params, z, training=True)
+        with capture_state():                 # throwaway: disc stats
+            f = disc(d_params, fake, training=True)
+        loss = ops_nn.sigmoid_cross_entropy_with_logits(
             f, jnp.ones_like(f)).mean()
-
-    # note: BN running stats are not captured here (each forward uses
-    # batch stats under training=True — the usual GAN practice); wrap
-    # with nn.capture_state if inference-mode stats are needed
+        return loss, dict(tape.updates)
 
     def step(g_state, d_state, real, key):
         zdim = g_state["params"]["fc"]["weight"].shape[0]
         z1, z2 = jax.random.split(key)
         z = jax.random.normal(z1, (real.shape[0], zdim))
-        dl, d_grads = jax.value_and_grad(d_loss)(
+        (dl, d_tape), d_grads = jax.value_and_grad(d_loss, has_aux=True)(
             d_state["params"], g_state["params"], real, z)
         d_new, d_opt_state = d_opt.update(d_grads, d_state["opt"],
                                           d_state["params"])
+        d_new = apply_state_updates(d_new, d_tape)
         d_state = dict(d_state, params=d_new, opt=d_opt_state)
 
         z = jax.random.normal(z2, (real.shape[0], zdim))
-        gl, g_grads = jax.value_and_grad(g_loss)(
+        (gl, g_tape), g_grads = jax.value_and_grad(g_loss, has_aux=True)(
             g_state["params"], d_state["params"], z)
         g_new, g_opt_state = g_opt.update(g_grads, g_state["opt"],
                                           g_state["params"])
+        g_new = apply_state_updates(g_new, g_tape)
         g_state = dict(g_state, params=g_new, opt=g_opt_state)
         return g_state, d_state, {"d_loss": dl, "g_loss": gl}
 

@@ -23,6 +23,8 @@ from paddle_tpu.ops import detection as D
 @dataclasses.dataclass
 class YOLOv3Config:
     num_classes: int = 80
+    # advisory only: loss/detect derive every scale from the actual input
+    # tensor, so any (stride-32-divisible) size works at call time
     image_size: int = 416
     backbone_scale: float = 1.0
     # COCO anchors (w, h) pixels; masks pick 3 per scale, big -> small

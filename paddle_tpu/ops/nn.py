@@ -922,3 +922,28 @@ def resize_trilinear(x, size):
     od, oh, ow = _triple(size) if not isinstance(size, tuple) else size
     return jax.image.resize(
         x, (x.shape[0], od, oh, ow, x.shape[4]), method="trilinear")
+
+
+@register_op("cvm")
+def continuous_value_model(x, *, use_cvm=True):
+    """cvm_op (CTR): embeddings arrive with leading (show, click)
+    counters per feature; with ``use_cvm`` they become
+    (log(show+1), log(click+1) - log(show+1)) — otherwise the two
+    counter slots are dropped. ``x`` (B, D), D >= 2."""
+    show = jnp.log(x[:, :1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], -1)
+    return x[:, 2:]
+
+
+@register_op("filter_by_instag", has_grad=False)
+def filter_by_instag(ins, ins_tags, filter_tags):
+    """filter_by_instag_op (CTR multi-task): keep rows whose tag set
+    intersects ``filter_tags``. Static shapes: returns (rows reordered
+    kept-first, keep_mask, index mapping) instead of the reference's
+    dynamically-sized output. ``ins_tags`` (B, T) padded with -1;
+    ``filter_tags`` (K,)."""
+    hit = (ins_tags[:, :, None] == filter_tags[None, None, :]).any((1, 2))
+    order = jnp.argsort(~hit)                  # kept rows first, stable
+    return ins[order], hit[order], order

@@ -93,3 +93,33 @@ class TestDCGAN:
                    jax.random.normal(key, (2, 16)))
         assert fake.shape == (2, 32, 32, 1)
         assert float(jnp.abs(fake).max()) <= 1.0
+
+
+class TestGANReviewFixes:
+    def test_bn_stats_update_through_gan_step(self):
+        from paddle_tpu.models.gan import (DCGANDiscriminator,
+                                           DCGANGenerator, gan_step)
+        gen = DCGANGenerator(zdim=8, base=8, n_up=3, out_ch=1)
+        disc = DCGANDiscriminator(in_ch=1, base=8, n_down=3)
+        g_opt = opt.Adam(learning_rate=1e-4)
+        d_opt = opt.Adam(learning_rate=1e-4)
+        gp = gen.init(jax.random.PRNGKey(0))
+        dp = disc.init(jax.random.PRNGKey(1))
+        mean0 = np.asarray(dp["bns"]["0"]["mean"]).copy()
+        g_state = {"params": gp, "opt": g_opt.init(gp)}
+        d_state = {"params": dp, "opt": d_opt.init(dp)}
+        step = jax.jit(gan_step(gen, disc, g_opt, d_opt))
+        real = jnp.asarray(
+            np.random.RandomState(0).randn(4, 32, 32, 1), jnp.float32)
+        g_state, d_state, _ = step(g_state, d_state, real,
+                                   jax.random.PRNGKey(2))
+        mean1 = np.asarray(d_state["params"]["bns"]["0"]["mean"])
+        assert not np.allclose(mean0, mean1)   # running stats moved
+
+    def test_discriminator_rejects_wrong_size(self):
+        import pytest
+        from paddle_tpu.models.gan import DCGANDiscriminator
+        disc = DCGANDiscriminator(in_ch=1, base=8, n_down=3)
+        params = disc.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError):
+            disc(params, jnp.zeros((1, 64, 64, 1)))

@@ -532,3 +532,25 @@ class TestRoiPerspective:
             feats, q, output_size=(4, 4)).sum())(quad)
         assert np.isfinite(np.asarray(g)).all()
         assert np.abs(np.asarray(g)).sum() > 0
+
+
+class TestCTRTail:
+    def test_cvm(self):
+        x = jnp.asarray([[3.0, 1.0, 7.0, 8.0]])
+        out = np.asarray(N.continuous_value_model(x))
+        np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+        np.testing.assert_allclose(out[0, 1], np.log(2.0) - np.log(4.0),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out[0, 2:], [7.0, 8.0])
+        no = np.asarray(N.continuous_value_model(x, use_cvm=False))
+        np.testing.assert_allclose(no, [[7.0, 8.0]])
+
+    def test_filter_by_instag(self):
+        ins = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+        tags = jnp.asarray([[1, -1], [2, 3], [4, -1], [3, -1]])
+        rows, keep, order = N.filter_by_instag(
+            ins, tags, jnp.asarray([3]))
+        k = np.asarray(keep)
+        assert k[:2].all() and not k[2:].any()    # rows 1,3 match tag 3
+        np.testing.assert_allclose(np.asarray(rows)[0],
+                                   np.asarray(ins)[1])
