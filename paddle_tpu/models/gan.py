@@ -103,8 +103,12 @@ def gan_step(gen, disc, g_opt, d_opt):
     def d_loss(d_params, g_params, real, z):
         with capture_state():                 # throwaway: gen stats
             fake = gen(g_params, z, training=True)
+        # the REAL batch carries the stats (a shared tape would let the
+        # fake forward overwrite them path-by-path — inference-mode BN
+        # must track real-data statistics); fake stats are discarded
         with capture_state() as tape:
             r = disc(d_params, real, training=True)
+        with capture_state():
             f = disc(d_params, jax.lax.stop_gradient(fake),
                      training=True)
         bce = ops_nn.sigmoid_cross_entropy_with_logits

@@ -1161,3 +1161,35 @@ def generate_mask_labels(rois, match_gt, fg_mask, gt_masks, *,
 
     targets = jax.vmap(one)(rois, jnp.maximum(match_gt, 0), fg_mask)
     return targets, fg_mask.astype(jnp.float32)
+
+
+@register_op("deformable_roi_pooling")
+def deformable_roi_pooling(features, rois, offsets=None, *,
+                           output_size=(7, 7), spatial_scale=1.0,
+                           gamma=0.1):
+    """Deformable RoI pooling (deformable_roi_pooling_op, Deformable
+    ConvNets): RoIAlign where each output bin's sampling center shifts by
+    a learned normalized offset, scaled by ``gamma`` and the RoI size.
+    ``features`` (H, W, C); ``rois`` (R, 4) xyxy; ``offsets``
+    (R, oh, ow, 2) [dy, dx] normalized (None = plain aligned pooling).
+    Differentiable w.r.t. features, rois AND offsets."""
+    oh, ow = output_size
+
+    def one(roi, off):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bw = rw / ow
+        bh = rh / oh
+        cy = y1 + (jnp.arange(oh) + 0.5) * bh                 # (oh,)
+        cx = x1 + (jnp.arange(ow) + 0.5) * bw                 # (ow,)
+        gy = jnp.broadcast_to(cy[:, None], (oh, ow))
+        gx = jnp.broadcast_to(cx[None, :], (oh, ow))
+        if off is not None:
+            gy = gy + gamma * rh * off[..., 0]
+            gx = gx + gamma * rw * off[..., 1]
+        return _bilinear_sample(features, gy, gx)             # (oh,ow,C)
+
+    if offsets is None:
+        return jax.vmap(lambda r: one(r, None))(rois)
+    return jax.vmap(one)(rois, offsets)

@@ -944,6 +944,9 @@ def filter_by_instag(ins, ins_tags, filter_tags):
     kept-first, keep_mask, index mapping) instead of the reference's
     dynamically-sized output. ``ins_tags`` (B, T) padded with -1;
     ``filter_tags`` (K,)."""
-    hit = (ins_tags[:, :, None] == filter_tags[None, None, :]).any((1, 2))
+    # a -1-padded filter_tags entry must never match -1-padded ins tags
+    match = (ins_tags[:, :, None] == filter_tags[None, None, :]) \
+        & (filter_tags[None, None, :] >= 0)
+    hit = match.any((1, 2))
     order = jnp.argsort(~hit)                  # kept rows first, stable
     return ins[order], hit[order], order

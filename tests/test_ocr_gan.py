@@ -123,3 +123,31 @@ class TestGANReviewFixes:
         params = disc.init(jax.random.PRNGKey(0))
         with pytest.raises(ValueError):
             disc(params, jnp.zeros((1, 64, 64, 1)))
+
+    def test_d_stats_track_real_batch(self):
+        from paddle_tpu.models.gan import (DCGANDiscriminator,
+                                           DCGANGenerator, gan_step)
+        gen = DCGANGenerator(zdim=8, base=8, n_up=3, out_ch=1)
+        disc = DCGANDiscriminator(in_ch=1, base=8, n_down=3)
+        g_opt = opt.Adam(learning_rate=0.0)   # freeze: isolate stats
+        d_opt = opt.Adam(learning_rate=0.0)
+        gp = gen.init(jax.random.PRNGKey(0))
+        dp = disc.init(jax.random.PRNGKey(1))
+        g_state = {"params": gp, "opt": g_opt.init(gp)}
+        d_state = {"params": dp, "opt": d_opt.init(dp)}
+        step = jax.jit(gan_step(gen, disc, g_opt, d_opt))
+        # lr=0 keeps params fixed, so after ONE step the running stats
+        # must equal a manual real-batch-only tape applied to the same
+        # params — if fake-forward stats leaked in, they would differ
+        from paddle_tpu.nn.module import (apply_state_updates,
+                                          capture_state)
+        real = jnp.full((8, 32, 32, 1), 5.0)
+        with capture_state() as tape:
+            disc(dp, real, training=True)
+        expected = apply_state_updates(dp, tape)["bns"]["0"]["mean"]
+        g_state, d_state, _ = step(g_state, d_state, real,
+                                   jax.random.PRNGKey(0))
+        got = d_state["params"]["bns"]["0"]["mean"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-6)
+        assert np.abs(np.asarray(got)).max() > 1e-4   # actually moved

@@ -554,3 +554,38 @@ class TestCTRTail:
         assert k[:2].all() and not k[2:].any()    # rows 1,3 match tag 3
         np.testing.assert_allclose(np.asarray(rows)[0],
                                    np.asarray(ins)[1])
+
+
+class TestDeformableRoiPooling:
+    def test_zero_offsets_sample_bin_centers(self):
+        feats = jnp.asarray(
+            np.arange(64, dtype=np.float32).reshape(8, 8, 1))
+        rois = jnp.asarray([[0.0, 0.0, 8.0, 8.0]])
+        out0 = D.deformable_roi_pooling(feats, rois, None,
+                                        output_size=(2, 2))
+        outz = D.deformable_roi_pooling(
+            feats, rois, jnp.zeros((1, 2, 2, 2)), output_size=(2, 2))
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(outz))
+
+    def test_offsets_shift_sampling_and_grads_flow(self):
+        feats = jnp.asarray(
+            np.arange(64, dtype=np.float32).reshape(8, 8, 1))
+        rois = jnp.asarray([[0.0, 0.0, 8.0, 8.0]])
+        off = jnp.zeros((1, 2, 2, 2)).at[0, 0, 0, 1].set(0.5)
+        shifted = D.deformable_roi_pooling(feats, rois, off,
+                                           output_size=(2, 2),
+                                           gamma=0.25)
+        base = D.deformable_roi_pooling(feats, rois, None,
+                                        output_size=(2, 2))
+        assert float(shifted[0, 0, 0, 0]) > float(base[0, 0, 0, 0])
+        g = jax.grad(lambda o: D.deformable_roi_pooling(
+            feats, rois, o, output_size=(2, 2)).sum())(off)
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_filter_by_instag_ignores_padding_tag(self):
+        ins = jnp.arange(4, dtype=jnp.float32).reshape(2, 2)
+        tags = jnp.asarray([[1, -1], [2, 3]])
+        _, keep, _ = N.filter_by_instag(ins, tags,
+                                        jnp.asarray([3, -1]))
+        k = np.asarray(keep)
+        assert k.sum() == 1            # only the real tag-3 row
