@@ -141,6 +141,16 @@ class Trainer:
             return {name: m.eval() for name, (m, _) in metrics.items()}
         return outs
 
+    def predict(self, predict_step: Callable,
+                data_iter: Iterable[Dict[str, Any]]):
+        """Forward-only pass collecting host numpy outputs per batch
+        (hapi Model.predict / infer_from_dataset convenience)."""
+        outs = []
+        for batch in data_iter:
+            out = predict_step(self.state["params"], **batch)
+            outs.append(jax.device_get(out))   # pytree -> host numpy
+        return outs
+
 
 def _fmt(metrics: Dict[str, float]) -> str:
     return " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))

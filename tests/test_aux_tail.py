@@ -372,3 +372,21 @@ class TestExecutorDatasetEdgeCases:
             lambda: iter(range(10)), 4, lambda s: {"n": len(s)},
             drop_last=True))
         assert [b["n"] for b in dropped] == [4, 4]
+
+
+class TestTrainerPredict:
+    def test_predict_collects_numpy(self):
+        from paddle_tpu.models.lenet import LeNet
+        from paddle_tpu.trainer import Trainer
+        model = LeNet(num_classes=3)
+        params = model.init(jax.random.PRNGKey(0))
+        state = {"params": params}
+        trainer = Trainer.__new__(Trainer)
+        trainer.state = state
+        step = jax.jit(lambda p, image: model(p, image))
+        batches = [dict(image=jnp.zeros((2, 28, 28, 1))),
+                   dict(image=jnp.ones((2, 28, 28, 1)))]
+        outs = trainer.predict(step, batches)
+        assert len(outs) == 2
+        assert isinstance(outs[0], np.ndarray)
+        assert outs[0].shape == (2, 3)

@@ -555,6 +555,14 @@ class TestCTRTail:
         np.testing.assert_allclose(np.asarray(rows)[0],
                                    np.asarray(ins)[1])
 
+    def test_filter_by_instag_ignores_padding_tag(self):
+        ins = jnp.arange(4, dtype=jnp.float32).reshape(2, 2)
+        tags = jnp.asarray([[1, -1], [2, 3]])
+        _, keep, _ = N.filter_by_instag(ins, tags,
+                                        jnp.asarray([3, -1]))
+        k = np.asarray(keep)
+        assert k.sum() == 1            # only the real tag-3 row
+
 
 class TestDeformableRoiPooling:
     def test_zero_offsets_sample_bin_centers(self):
@@ -581,11 +589,3 @@ class TestDeformableRoiPooling:
         g = jax.grad(lambda o: D.deformable_roi_pooling(
             feats, rois, o, output_size=(2, 2)).sum())(off)
         assert np.abs(np.asarray(g)).sum() > 0
-
-    def test_filter_by_instag_ignores_padding_tag(self):
-        ins = jnp.arange(4, dtype=jnp.float32).reshape(2, 2)
-        tags = jnp.asarray([[1, -1], [2, 3]])
-        _, keep, _ = N.filter_by_instag(ins, tags,
-                                        jnp.asarray([3, -1]))
-        k = np.asarray(keep)
-        assert k.sum() == 1            # only the real tag-3 row
