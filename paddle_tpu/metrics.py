@@ -70,8 +70,10 @@ class Auc(Metric):
         tot_p, tot_n = tp[-1], fp[-1]
         if tot_p == 0 or tot_n == 0:
             return 0.5
-        tpr = tp / tot_p
-        fpr = fp / tot_n
+        # prepend (0,0) so the first trapezoid from the origin is counted,
+        # matching the in-graph auc op's integration (ops/metrics_ops.py)
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
         return float(np.trapezoid(tpr, fpr))
 
 

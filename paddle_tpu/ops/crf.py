@@ -85,8 +85,8 @@ def crf_decoding(emission, transition, length, *, start=None, stop=None,
     """Viterbi decode (crf_decoding_op). Same layouts as
     :func:`linear_chain_crf`. Returns (B, T) best paths (entries past
     ``length`` are 0). With ``label`` given, returns instead a (B, T)
-    0/1 mismatch mask like the reference (1 where decoded != label,
-    only within length)."""
+    0/1 correctness mask like the reference (crf_decoding_op.h:70,99:
+    1 where decoded == label, 0 elsewhere and past length)."""
     b, t_len, n = emission.shape
     if start is not None:
         emission = emission.at[:, 0, :].add(start[None, :])
@@ -125,9 +125,9 @@ def crf_decoding(emission, transition, length, *, start=None, stop=None,
 
     paths = jax.vmap(one)(emission, length)
     if label is not None:
-        mism = (paths != label) & (
+        correct = (paths == label) & (
             jnp.arange(t_len)[None, :] < length[:, None])
-        return mism.astype(jnp.int32)
+        return correct.astype(jnp.int32)
     return paths
 
 

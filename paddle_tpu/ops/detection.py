@@ -76,15 +76,19 @@ def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
     cx = (jnp.arange(feature_w) + offset) * step_w
     cx, cy = jnp.meshgrid(cx, cy)  # (H, W)
 
+    # Reference default order (prior_box_op.h:139, min_max_aspect_ratios_
+    # order=false): per min_size emit every aspect-ratio box (ar=1 first),
+    # THEN that min_size's sqrt(min*max) box — interleaved, not appended
+    # after the loop, so anchors line up with reference head channels.
     whs = []
-    for ms in min_sizes:
+    for i, ms in enumerate(min_sizes):
         whs.append((ms, ms))
         for ar in aspect_ratios:
             if abs(ar - 1.0) < 1e-6:
                 continue
             whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
-    for ms, Ms in zip(min_sizes, max_sizes):
-        whs.append(((ms * Ms) ** 0.5,) * 2)
+        if i < len(max_sizes):
+            whs.append(((ms * max_sizes[i]) ** 0.5,) * 2)
     whs = jnp.asarray(whs)  # (A, 2)
 
     centers = jnp.stack([cx, cy], -1).reshape(-1, 1, 2)       # (HW, 1, 2)
@@ -99,7 +103,7 @@ def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
 
 @register_op("yolo_box")
 def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
-             downsample_ratio=32, scale_x_y=1.0):
+             downsample_ratio=32, scale_x_y=1.0, clip_bbox=True):
     """Decode a YOLOv3 head (yolo_box_op). x: (B, A*(5+C), H, W) NCHW like
     the reference; anchors: [(w,h), ...] in pixels. Returns (boxes
     (B, H*W*A, 4) xyxy in image pixels, scores (B, H*W*A, C))."""
@@ -126,6 +130,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
     boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
                        cx + bw / 2, cy + bh / 2], -1)
     boxes = boxes.reshape(b, -1, 4) * jnp.tile(img_wh, (1, 1, 2))
+    if clip_bbox:
+        # yolo_box_op CalcDetectionBox (yolo_box_op.h:48): x1/y1 floor at 0,
+        # x2/y2 ceil at img_w-1 / img_h-1.
+        boxes = jnp.concatenate([
+            jnp.maximum(boxes[..., :2], 0.0),
+            jnp.minimum(boxes[..., 2:], img_wh - 1.0)], -1)
     return boxes, probs.reshape(b, -1, c)
 
 
@@ -741,7 +751,9 @@ def generate_proposals(scores, deltas, anchors, im_shape, *,
         roi_scores = jnp.concatenate(
             [roi_scores, jnp.full((pad,), -jnp.inf)])
         valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
-    return rois, jnp.where(valid, roi_scores, 0.0), valid
+    # invalid rows keep -inf scores so downstream top-k (e.g.
+    # collect_fpn_proposals without valid_list) can never pick padding
+    return rois, jnp.where(valid, roi_scores, -jnp.inf), valid
 
 
 @register_op("distribute_fpn_proposals")
@@ -768,8 +780,9 @@ def collect_fpn_proposals(rois_list, scores_list, valid_list=None, *,
                           post_nms_top_n=1000):
     """Merge per-level proposals and keep the global top-k by score
     (collect_fpn_proposals_op.cc). Inputs: lists of (Ni, 4) / (Ni,);
-    ``valid_list`` carries :func:`generate_proposals`' validity masks so
-    its zero-padded entries never outrank real proposals.
+    ``valid_list`` carries :func:`generate_proposals`' validity masks.
+    Padding is also safe without it: generate_proposals keeps -inf
+    scores on invalid rows, which the isfinite check here rejects.
     Returns (rois (k, 4), scores (k,), valid (k,))."""
     rois = jnp.concatenate(rois_list, axis=0)
     scores = jnp.concatenate(scores_list, axis=0)
@@ -785,7 +798,8 @@ def collect_fpn_proposals(rois_list, scores_list, valid_list=None, *,
         out_r = jnp.concatenate([out_r, jnp.zeros((pad, 4))])
         top_s = jnp.concatenate([top_s, jnp.full((pad,), -jnp.inf)])
         valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
-    return out_r, jnp.where(valid, top_s, 0.0), valid
+    # invalid rows keep -inf (same convention as generate_proposals)
+    return out_r, top_s, valid
 
 
 @register_op("polygon_box_transform")

@@ -102,11 +102,23 @@ class TestLinearChainCRF:
         paths = C.crf_decoding(
             jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
             start=jnp.asarray(start), stop=jnp.asarray(stop))
-        mism = np.asarray(C.crf_decoding(
+        correct = np.asarray(C.crf_decoding(
             jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
             start=jnp.asarray(start), stop=jnp.asarray(stop),
             label=paths))
-        assert mism.sum() == 0          # decoded vs itself: no mismatch
+        # decoded vs itself: 1 within length (reference convention), 0 past
+        for i in range(correct.shape[0]):
+            ln = int(length[i])
+            assert (correct[i, :ln] == 1).all()
+            assert (correct[i, ln:] == 0).all()
+        # a corrupted label row must score 0 at the corrupted position
+        bad = np.asarray(paths).copy()
+        bad[0, 0] = (bad[0, 0] + 1) % int(em.shape[-1])
+        c2 = np.asarray(C.crf_decoding(
+            jnp.asarray(em), jnp.asarray(trans), jnp.asarray(length),
+            start=jnp.asarray(start), stop=jnp.asarray(stop),
+            label=jnp.asarray(bad)))
+        assert c2[0, 0] == 0
 
     def test_training_reduces_nll(self):
         rng = np.random.RandomState(4)
