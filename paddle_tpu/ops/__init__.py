@@ -6,7 +6,7 @@ pattern (op_registry.h:199) without global constructors.
 """
 
 from paddle_tpu.ops import (activation, attention, crf, detection,
-                            elementwise, math, metrics_ops, nn,
+                            elementwise, math, metrics_ops, niche, nn,
                             reduction, sequence, tensor)
 from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
                                       flash_attention,

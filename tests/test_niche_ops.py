@@ -1,0 +1,317 @@
+"""OpTest parity for the eight niche root ops (ops/niche.py) against
+brute-force numpy references transcribed from the reference kernels
+(sample_logits_op.h, unpool_op.cc, spp_op.h, conv_shift_op.cc,
+tree_conv_op.h/tree2col.cc, var_conv_2d_op.cc, modified_huber_loss_op.h,
+sequence_topk_avg_pooling_op.h)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import niche as NI
+from paddle_tpu.testing import check_grad, check_output
+
+
+class TestModifiedHuberLoss:
+    def test_forward_branches(self):
+        x = np.asarray([-3.0, -0.5, 0.2, 2.0, 0.9], np.float32)
+        y = np.asarray([1.0, 0.0, 1.0, 1.0, 0.0], np.float32)
+        a = x * (2 * y - 1)
+        want = np.where(a < -1, -4 * a, np.where(a < 1, (1 - a) ** 2, 0))
+        np.testing.assert_allclose(
+            np.asarray(NI.modified_huber_loss(jnp.asarray(x),
+                                              jnp.asarray(y))), want)
+
+    def test_grad(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(8).astype(np.float32) * 2
+        # keep away from the |a|=1 kinks where FD is invalid
+        x = np.where(np.abs(np.abs(x) - 1.0) < 0.05, x + 0.2, x)
+        y = (rng.rand(8) > 0.5).astype(np.float32)
+        check_grad(lambda a: NI.modified_huber_loss(a, jnp.asarray(y)), [x])
+
+
+class TestUnpool:
+    def test_roundtrip_with_maxpool_indices(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 4, 6).astype(np.float32)
+        # brute-force 2x2 max pool with indices (unpool_op's producer)
+        pooled = np.zeros((2, 3, 2, 3), np.float32)
+        idx = np.zeros((2, 3, 2, 3), np.int32)
+        for b in range(2):
+            for c in range(3):
+                for i in range(2):
+                    for j in range(3):
+                        win = x[b, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                        k = int(np.argmax(win))
+                        pooled[b, c, i, j] = win.flat[k]
+                        idx[b, c, i, j] = (2 * i + k // 2) * 6 + (2 * j + k % 2)
+        out = np.asarray(NI.unpool(jnp.asarray(pooled), jnp.asarray(idx),
+                                   (4, 6)))
+        want = np.zeros_like(x)
+        for b in range(2):
+            for c in range(3):
+                for i in range(6):
+                    want[b, c].flat[idx[b, c].flat[i]] = pooled[b, c].flat[i]
+        np.testing.assert_allclose(out, want)
+        # every pooled value lands at its argmax; rest zero
+        assert (np.count_nonzero(out) <= 2 * 3 * 6)
+
+    def test_grad_routes_to_indices(self):
+        pooled = np.asarray([[[[1.0, 2.0]]]], np.float32)
+        idx = np.asarray([[[[0, 3]]]], np.int32)
+        g = jax.grad(lambda p: jnp.sum(
+            NI.unpool(p, jnp.asarray(idx), (2, 2)) * 2.0))(jnp.asarray(pooled))
+        np.testing.assert_allclose(np.asarray(g), [[[[2.0, 2.0]]]])
+
+
+def _spp_ref(x, pyramid_height, pooling_type):
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(pyramid_height):
+        bins = 2 ** p
+        kh, kw = math.ceil(h / bins), math.ceil(w / bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        lvl = np.zeros((n, c, bins, bins), np.float64)
+        for b in range(n):
+            for ch in range(c):
+                for i in range(bins):
+                    for j in range(bins):
+                        y0, x0 = i * kh - ph, j * kw - pw
+                        ys = slice(max(y0, 0), min(y0 + kh, h))
+                        xs = slice(max(x0, 0), min(x0 + kw, w))
+                        win = x[b, ch, ys, xs]
+                        lvl[b, ch, i, j] = (win.max() if pooling_type == "max"
+                                            else win.mean())
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return np.concatenate(outs, 1)
+
+
+class TestSpp:
+    @pytest.mark.parametrize("ptype", ["max", "avg"])
+    def test_matches_bruteforce(self, ptype):
+        rng = np.random.RandomState(2)
+        # shapes chosen so no pyramid window falls entirely in padding
+        # (there the reference's own kernel hits -FLT_MAX / 0-divide)
+        x = rng.randn(2, 3, 8, 6).astype(np.float32)
+        got = np.asarray(NI.spp(jnp.asarray(x), 3, ptype))
+        want = _spp_ref(x, 3, ptype)
+        assert got.shape == (2, 3 * (1 + 4 + 16))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        check_grad(lambda a: NI.spp(a, 2, "avg"), [x])
+
+
+class TestConvShift:
+    def test_matches_reference_formula(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 7).astype(np.float32)
+        y = rng.randn(3, 5).astype(np.float32)
+        check_output(NI.conv_shift, NI._conv_shift_ref, [x, y])
+
+    def test_grad_both_inputs(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 6).astype(np.float32)
+        y = rng.randn(2, 3).astype(np.float32)
+        check_grad(NI.conv_shift, [x, y], wrt=(0, 1))
+
+    def test_even_filter_rejected(self):
+        with pytest.raises(ValueError):
+            NI.conv_shift(jnp.zeros((1, 4)), jnp.zeros((1, 4)))
+
+    def test_oversized_filter_rejected(self):
+        with pytest.raises(ValueError):
+            NI.conv_shift(jnp.zeros((1, 3)), jnp.zeros((1, 5)))
+
+
+class TestTreeConv:
+    def test_single_root_star_tree(self):
+        # star: node 1 connected to 2,3,4; features one-hot
+        edges = np.asarray([[[1, 2], [1, 3], [1, 4]]], np.int32)
+        feats = np.eye(4, dtype=np.float32)[None]              # (1,4,4)
+        f, out_size, m = 4, 2, 3
+        filt = np.ones((f, 3, out_size, m), np.float32)
+        out = np.asarray(NI.tree_conv(jnp.asarray(feats),
+                                      jnp.asarray(edges),
+                                      jnp.asarray(filt), max_depth=2))
+        assert out.shape == (1, 4, out_size, m)
+        # root 1's patch covers all nodes; each leaf's patch is itself +
+        # (depth-limited) nothing else at max_depth=2... the filter sums
+        # eta weights * features, so out[0,0] > out[0,1] elementwise
+        assert (out[0, 0] > out[0, 1]).all()
+
+    def test_depth_weights_match_manual(self):
+        # chain 1-2, max_depth 2: patch(1) = {1 (d0), 2 (d1)}
+        edges = np.asarray([[[1, 2]]], np.int32)
+        feats = np.asarray([[[1.0], [10.0]]], np.float32)      # (1,2,1)
+        filt = np.zeros((1, 3, 1, 1), np.float32)
+        filt[0, 2, 0, 0] = 1.0                                 # eta_t tap
+        out = np.asarray(NI.tree_conv(jnp.asarray(feats),
+                                      jnp.asarray(edges),
+                                      jnp.asarray(filt), max_depth=2))
+        # root1: eta_t(d0)=1 on node1, eta_t(d1)=0.5 on node2 -> 1 + 5
+        np.testing.assert_allclose(out[0, 0, 0, 0], 6.0, rtol=1e-6)
+
+    def test_grad_wrt_features_and_filter(self):
+        edges = np.asarray([[[1, 2], [2, 3]]], np.int32)
+        rng = np.random.RandomState(6)
+        feats = rng.randn(1, 3, 2).astype(np.float32)
+        filt = rng.randn(2, 3, 2, 2).astype(np.float32)
+        check_grad(lambda nv, fl: NI.tree_conv(nv, jnp.asarray(edges), fl,
+                                               max_depth=2),
+                   [feats, filt], wrt=(0, 1))
+
+
+def _var_conv_ref(x, row_lens, col_lens, w, ic, oc, kh, kw, sh, sw):
+    bsz, _, hm, wm = x.shape
+    oh, ow = (hm - 1) // sh + 1, (wm - 1) // sw + 1
+    out = np.zeros((bsz, oc, oh, ow), np.float64)
+    kern = w.reshape(oc, ic, kh, kw)
+    for b in range(bsz):
+        h, wdt = int(row_lens[b]), int(col_lens[b])
+        if h == 0 or wdt == 0:
+            continue
+        th, tw = (h - 1) // sh + 1, (wdt - 1) // sw + 1
+        for o in range(oc):
+            for y in range(th):
+                for xx in range(tw):
+                    acc = 0.0
+                    for z in range(ic):
+                        for ky in range(kh):
+                            for kx in range(kw):
+                                iy = y * sh + ky - kh // 2
+                                ix = xx * sw + kx - kw // 2
+                                if 0 <= iy < h and 0 <= ix < wdt:
+                                    acc += kern[o, z, ky, kx] * x[b, z, iy, ix]
+                    out[b, o, y, xx] = acc
+    return out
+
+
+class TestVarConv2d:
+    def test_matches_bruteforce_varlen(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 2, 6, 5).astype(np.float32)
+        row = np.asarray([6, 3])
+        col = np.asarray([5, 2])
+        w = rng.randn(3, 2 * 3 * 3).astype(np.float32)
+        got = np.asarray(NI.var_conv_2d(
+            jnp.asarray(x), jnp.asarray(row), jnp.asarray(col),
+            jnp.asarray(w), input_channel=2, output_channel=3,
+            kernel_h=3, kernel_w=3, stride_h=2, stride_w=1))
+        want = _var_conv_ref(x, row, col, w, 2, 3, 3, 3, 2, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_zero_length_sample(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        out = np.asarray(NI.var_conv_2d(
+            jnp.asarray(x), jnp.asarray([0]), jnp.asarray([4]),
+            jnp.ones((1, 9), jnp.float32), input_channel=1,
+            output_channel=1))
+        assert (out == 0).all()
+
+    def test_grad(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(1, 1, 4, 4).astype(np.float32)
+        w = rng.randn(2, 9).astype(np.float32)
+        check_grad(lambda a, b: NI.var_conv_2d(
+            a, jnp.asarray([3]), jnp.asarray([4]), b, input_channel=1,
+            output_channel=2), [x, w], wrt=(0, 1))
+
+
+class TestSampleLogits:
+    def test_customized_samples_exact(self):
+        rng = np.random.RandomState(9)
+        logits = rng.randn(2, 10).astype(np.float32)
+        labels = np.asarray([[1], [7]])
+        cs = np.asarray([[1, 3, 7], [7, 2, 7]])
+        cp = np.full((2, 3), 0.25, np.float32)
+        s, p, sl, slab = NI.sample_logits(
+            jnp.asarray(logits), jnp.asarray(labels), 2,
+            customized_samples=jnp.asarray(cs),
+            customized_probabilities=jnp.asarray(cp))
+        np.testing.assert_array_equal(np.asarray(s), cs)
+        np.testing.assert_array_equal(np.asarray(slab), [[0], [0]])
+        want = logits[np.arange(2)[:, None], cs] - np.log(0.25)
+        # accidental hits: row0 col2 (==label 1? no, 7 != 1) none;
+        # row1 cols 1,2: sample 7 == label 7 at col2 (negative part)
+        want[1, 2] -= 1e20
+        got = np.asarray(sl)
+        np.testing.assert_allclose(got[0], want[0], rtol=1e-5)
+        np.testing.assert_allclose(got[1, :2], want[1, :2], rtol=1e-5)
+        assert got[1, 2] < -1e19
+
+    def test_sampled_distribution_and_q(self):
+        logits = jnp.zeros((4, 50))
+        labels = jnp.asarray([[0], [1], [2], [3]])
+        s, p, sl, _ = NI.sample_logits(
+            logits, labels, 16, rng=jax.random.PRNGKey(0),
+            remove_accidental_hits=False)
+        s = np.asarray(s)
+        assert s.shape == (4, 17)
+        assert (s >= 0).all() and (s < 50).all()
+        # negatives shared across batch (reference samples once per batch)
+        assert (s[:, 1:] == s[0, 1:]).all()
+        # Q matches the log-uniform closed form * num_samples
+        v = s[0].astype(np.float64)
+        q = np.log((v + 2) / (v + 1)) / np.log(51.0) * 16
+        np.testing.assert_allclose(np.asarray(p)[0], q, rtol=1e-5)
+
+    def test_log_uniform_skew(self):
+        # log-uniform sampling strongly favors small class ids
+        logits = jnp.zeros((1, 10000))
+        labels = jnp.zeros((1, 1), jnp.int32)
+        s, _, _, _ = NI.sample_logits(
+            logits, labels, 2000, rng=jax.random.PRNGKey(1))
+        neg = np.asarray(s)[0, 1:]
+        assert (neg < 100).mean() > 0.3   # P(<100) = log(101)/log(10001) ≈ .5
+
+
+def _topk_avg_ref(x, row_lens, col_lens, topks):
+    b, c, rm, cm = x.shape
+    out = np.zeros((b, rm, c, len(topks)), np.float64)
+    max_k = max(topks)
+    for i in range(b):
+        for j in range(c):
+            for r in range(int(row_lens[i])):
+                row = x[i, j, r, :int(col_lens[i])]
+                top = np.sort(row)[::-1]
+                sums = np.zeros(max_k)
+                for k in range(max_k):
+                    sums[k] = (sums[k - 1] if k >= len(top)
+                               else (sums[k - 1] if k else 0) + top[k])
+                for ki, k in enumerate(topks):
+                    out[i, r, j, ki] = sums[k - 1] / k
+    return out
+
+
+class TestSequenceTopkAvgPooling:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(10)
+        x = rng.randn(2, 3, 4, 6).astype(np.float32)
+        row = np.asarray([4, 2])
+        col = np.asarray([6, 3])
+        got = np.asarray(NI.sequence_topk_avg_pooling(
+            jnp.asarray(x), jnp.asarray(row), jnp.asarray(col),
+            topks=(1, 3, 5)))
+        want = _topk_avg_ref(x, row, col, (1, 3, 5))
+        assert got.shape == (2, 4, 3, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_saturating_sum_short_rows(self):
+        # 2 valid columns, k=4: average of the 2 valid values over 4
+        x = jnp.asarray([[[[3.0, 1.0, 99.0, 99.0]]]])
+        got = np.asarray(NI.sequence_topk_avg_pooling(
+            x, jnp.asarray([1]), jnp.asarray([2]), topks=(4,)))
+        np.testing.assert_allclose(got[0, 0, 0, 0], 1.0)   # (3+1)/4
+
+    def test_grad(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(1, 2, 2, 4).astype(np.float32)
+        check_grad(lambda a: NI.sequence_topk_avg_pooling(
+            a, jnp.asarray([2]), jnp.asarray([3]), topks=(2,)), [x])

@@ -20,6 +20,25 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def _is_local(obj, name, mod_name):
+    """True when ``obj`` belongs to the paddle_tpu surface: defined inside
+    the package, or a fluid-named op that delegates straight to a jax
+    function but is registered in the OpInfoMap (ops.relu, ops.sqrt, …).
+    Typing aliases / __future__ features have foreign ``__module__``s and
+    no registry entry, so they are rejected."""
+    mod = getattr(obj, "__module__", None)
+    if mod is not None and mod.split(".")[0] == "paddle_tpu":
+        return True
+    if mod_name.startswith("paddle_tpu.ops"):
+        from paddle_tpu.core import registry
+        if name in registry.list_ops():
+            return True
+        # __all__-listed aliases of registered ops (ops.silu = swish)
+        if any(registry.get_op(n).fn is obj for n in registry.list_ops()):
+            return True
+    return False
+
+
 def iter_api():
     import paddle_tpu as pt
 
@@ -40,11 +59,16 @@ def iter_api():
         "paddle_tpu.trainer": pt.trainer,
     }
     for mod_name, mod in sorted(modules.items()):
-        names = getattr(mod, "__all__", None) or [
-            n for n in dir(mod) if not n.startswith("_")]
+        explicit = getattr(mod, "__all__", None)
+        names = explicit or [n for n in dir(mod) if not n.startswith("_")]
         for name in sorted(set(names)):
             obj = getattr(mod, name, None)
             if obj is None or inspect.ismodule(obj):
+                continue
+            if not explicit and not _is_local(obj, name, mod_name):
+                # dir() fallback leaks imports (typing.Any, __future__
+                # annotations, …) — only symbols defined in this package
+                # belong to the frozen surface (≙ API.spec is curated)
                 continue
             try:
                 sig = str(inspect.signature(obj))
