@@ -45,6 +45,11 @@ class BertConfig:
     # Embeddings/heads stay outside the pipelined middle.
     pipeline: bool = False
     pp_microbatches: int = 2
+    # "gpipe", or "circular" (interleaved 1F1B; pp_circuits virtual
+    # stages per device — smaller bubble, see
+    # parallel.pipeline.pipeline_bubble_fraction)
+    pp_schedule: str = "gpipe"
+    pp_circuits: int = 1
     # scan-over-layers param layout: encoder params stored as stacked
     # (L, ...) leaves sharded over "pp" from init — one compiled block
     # (faster compile), and pipeline stages own their rows by placement
@@ -194,7 +199,8 @@ class BertModel(Layer):
                 lp, h, bias=extra, key=k, training=training),
             enc_params,
             x, num_microbatches=M, layer_keys=layer_keys,
-            extras=extras, extras_spec=extras_spec)
+            extras=extras, extras_spec=extras_spec,
+            schedule=cfg.pp_schedule, num_circuits=cfg.pp_circuits)
 
 
 class BertPretrainingHeads(Layer):

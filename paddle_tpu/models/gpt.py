@@ -34,6 +34,8 @@ class GPTConfig:
     # GPipe the block stack over the "pp" mesh axis (parallel/pipeline.py)
     pipeline: bool = False
     pp_microbatches: int = 2
+    pp_schedule: str = "gpipe"    # or "circular" (interleaved 1F1B)
+    pp_circuits: int = 1
     # stacked (L, ...) scan-over-layers param layout (see BertConfig);
     # defaults on with pipeline. NOTE: changes the checkpoint tree —
     # migrate older per-layer trees with
@@ -135,7 +137,8 @@ class GPT(Layer):
             lambda lp, h, extra, k: block0(lp, h, key=k,
                                            training=training),
             blk_params, x, num_microbatches=cfg.pp_microbatches,
-            layer_keys=layer_keys)
+            layer_keys=layer_keys, schedule=cfg.pp_schedule,
+            num_circuits=cfg.pp_circuits)
 
     def loss(self, params, ids, *, key=None, training=True):
         """Next-token LM loss over ids (B, S): predict ids[:,1:]."""

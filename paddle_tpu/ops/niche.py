@@ -305,6 +305,10 @@ def sample_logits(logits, labels, num_samples, rng=None, *,
         return jnp.log((v + 2.0) / (v + 1.0)) / log_range
 
     if customized_samples is not None:
+        if customized_probabilities is None:
+            raise ValueError("customized_samples requires "
+                             "customized_probabilities (the reference's "
+                             "use_customized_samples path takes both)")
         samples = customized_samples
         probabilities = customized_probabilities
     else:

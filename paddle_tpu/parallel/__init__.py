@@ -14,8 +14,11 @@ from paddle_tpu.parallel.embedding import (ShardedEmbedding,
 from paddle_tpu.parallel.plan import (Rule, ShardingPlan, fsdp_plan,
                                       megatron_plan, named_shardings,
                                       replicated_plan)
-from paddle_tpu.parallel.pipeline import (gpipe, microbatch,
-                                          stack_layer_params, unmicrobatch)
+from paddle_tpu.parallel.pipeline import (circular_pipeline, gpipe,
+                                          interleave_stack, microbatch,
+                                          pipeline_bubble_fraction,
+                                          stack_layer_params,
+                                          uninterleave_stack, unmicrobatch)
 from paddle_tpu.parallel.ring_attention import ring_attention
 
 __all__ = [
@@ -23,5 +26,7 @@ __all__ = [
     "with_sharding_constraint", "Rule", "ShardingPlan", "fsdp_plan",
     "megatron_plan", "named_shardings", "replicated_plan",
     "ShardedEmbedding", "vocab_parallel_lookup", "ring_attention",
-    "gpipe", "microbatch", "stack_layer_params", "unmicrobatch",
+    "gpipe", "circular_pipeline", "pipeline_bubble_fraction",
+    "interleave_stack", "uninterleave_stack",
+    "microbatch", "stack_layer_params", "unmicrobatch",
 ]
