@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 from paddle_tpu import amp
 from paddle_tpu import optimizer as opt

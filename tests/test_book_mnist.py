@@ -7,6 +7,7 @@ and train data-parallel on the 8-device mesh with identical convergence.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import io, optimizer as opt
@@ -75,6 +76,7 @@ def test_mnist_eval_and_checkpoint(tmp_path):
     np.testing.assert_allclose(float(out1), float(out2))
 
 
+@pytest.mark.slow
 def test_mnist_data_parallel_matches_single(mesh8):
     """DP-on-mesh must converge like single-device (parity with
     parallel_executor_test_base.py loss-parity methodology)."""
@@ -86,6 +88,7 @@ def test_mnist_data_parallel_matches_single(mesh8):
     assert dp[-1] < 0.5 * dp[0]
 
 
+@pytest.mark.slow
 def test_mnist_grad_accum():
     """grad_accum=4 with 4x batch ≈ plain training (BatchMergePass parity)."""
     _, _, losses = _train(steps=20, batch_size=128, grad_accum=4)

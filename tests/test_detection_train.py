@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 from paddle_tpu.ops import detection as D
 

@@ -23,6 +23,8 @@ import time
 
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 _WORKER = os.path.join(os.path.dirname(__file__), "dist_worker.py")
 

@@ -5,6 +5,9 @@ labels -> roi_align -> box head), static shapes throughout."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
 
 
 def _batch(b=2, g=2, classes=4, size=64, seed=0):

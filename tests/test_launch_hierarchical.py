@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 from paddle_tpu.core.mesh import make_mesh, mesh_context
 from paddle_tpu.parallel import collective

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 from paddle_tpu.inference import Predictor, save_inference_model
 from paddle_tpu.nn.layers import Linear

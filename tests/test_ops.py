@@ -80,6 +80,9 @@ def _sample_args(name):
                       randn(4, 1), randn(4, 1)),
         "hinge_loss": (randn(4, 1),
                        RNG.randint(0, 2, (4, 1)).astype(np.float32)),
+        "conv_shift": (randn(4, 7), randn(4, 3)),
+        "modified_huber_loss": (randn(4, 6),
+                                RNG.randint(0, 2, (4, 6)).astype(np.float32)),
     }
     if name in ("equal", "not_equal", "less_than", "less_equal",
                 "greater_than", "greater_equal"):

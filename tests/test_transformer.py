@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow  # excluded from the quick CI gate
+
 
 from paddle_tpu.models.bert import BertConfig, BertForPretraining, BertModel
 from paddle_tpu.nn.transformer import (MultiHeadAttention,
