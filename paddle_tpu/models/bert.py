@@ -50,6 +50,10 @@ class BertConfig:
     # parallel.pipeline.pipeline_bubble_fraction)
     pp_schedule: str = "gpipe"
     pp_circuits: int = 1
+    # params already hold the circular schedule's interleaved layer order
+    # (convert once with parallel.pipeline.interleave_stack on the
+    # encoder stack) — skips the per-step cross-device weight reshuffle
+    pp_pre_interleaved: bool = False
     # scan-over-layers param layout: encoder params stored as stacked
     # (L, ...) leaves sharded over "pp" from init — one compiled block
     # (faster compile), and pipeline stages own their rows by placement
@@ -200,7 +204,8 @@ class BertModel(Layer):
             enc_params,
             x, num_microbatches=M, layer_keys=layer_keys,
             extras=extras, extras_spec=extras_spec,
-            schedule=cfg.pp_schedule, num_circuits=cfg.pp_circuits)
+            schedule=cfg.pp_schedule, num_circuits=cfg.pp_circuits,
+            pre_interleaved=cfg.pp_pre_interleaved)
 
 
 class BertPretrainingHeads(Layer):

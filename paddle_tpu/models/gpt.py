@@ -36,6 +36,8 @@ class GPTConfig:
     pp_microbatches: int = 2
     pp_schedule: str = "gpipe"    # or "circular" (interleaved 1F1B)
     pp_circuits: int = 1
+    pp_pre_interleaved: bool = False  # params pre-converted w/
+    #   parallel.pipeline.interleave_stack (skips per-step reshuffle)
     # stacked (L, ...) scan-over-layers param layout (see BertConfig);
     # defaults on with pipeline. NOTE: changes the checkpoint tree —
     # migrate older per-layer trees with
@@ -138,7 +140,8 @@ class GPT(Layer):
                                            training=training),
             blk_params, x, num_microbatches=cfg.pp_microbatches,
             layer_keys=layer_keys, schedule=cfg.pp_schedule,
-            num_circuits=cfg.pp_circuits)
+            num_circuits=cfg.pp_circuits,
+            pre_interleaved=cfg.pp_pre_interleaved)
 
     def loss(self, params, ids, *, key=None, training=True):
         """Next-token LM loss over ids (B, S): predict ids[:,1:]."""

@@ -399,7 +399,15 @@ def gpipe_layer_stack(
                if isinstance(params_list, (list, tuple)) else params_list)
     has_keys = layer_keys is not None and layer_keys[0] is not None
     if has_keys:
-        stacked = (stacked, jnp.stack(list(layer_keys)))
+        lkeys = jnp.stack(list(layer_keys))
+        if pre_interleaved and schedule == "circular":
+            # params are stored interleaved but keys are built fresh in
+            # canonical layer order every step — arrange them to match
+            # so the layer->key binding is layout-independent
+            mesh_ = mesh or mesh_lib.current_mesh()
+            lkeys = interleave_stack(lkeys, mesh_.shape[mesh_lib.PP],
+                                     num_circuits)
+        stacked = (stacked, lkeys)
 
     def block(lp, h, extra, mb_idx):
         if has_keys:

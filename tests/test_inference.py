@@ -48,3 +48,108 @@ def test_exported_model_loads_without_model_class(tmp_path):
     out = pred.run(jnp.ones((1, 28, 28, 1)))
     assert np.asarray(out).shape == (1, 4)
     assert pred.meta["inputs"][0]["shape"] == [1, 28, 28, 1]
+
+
+class TestInt8Serving:
+    """int8 weight-quantized serving artifacts (QuantizationFreezePass ->
+    save_inference_model parity, quantization_pass.py:587): PTQ and
+    QAT-frozen params round-trip through export -> Predictor with a
+    bounded accuracy drop and a ~4x smaller artifact."""
+
+    def _trained_mlp(self):
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.nn.layers import Linear
+        from paddle_tpu.nn.module import Layer
+
+        class MLP(Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = Linear(8, 256, sharding=None)
+                self.fc2 = Linear(256, 3, sharding=None)
+
+            def forward(self, params, x):
+                h = jnp.tanh(self.fc1(params["fc1"], x))
+                return self.fc2(params["fc2"], h)
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 8), np.float32)
+        y = jnp.asarray(rng.randint(0, 3, (64,)))
+        model = MLP()
+        params = model.init(jax.random.PRNGKey(0))
+        tx = opt.Adam(learning_rate=1e-2)
+        ostate = tx.init(params)
+
+        from paddle_tpu.ops import nn as F
+
+        @jax.jit
+        def step(params, ostate):
+            def loss(p):
+                return F.softmax_with_cross_entropy(
+                    model(p, x), y).mean()
+            l, g = jax.value_and_grad(loss)(params)
+            params, ostate = tx.update(g, ostate, params)
+            return params, ostate, l
+
+        for _ in range(60):
+            params, ostate, _ = step(params, ostate)
+        return model, params, x, y
+
+    def test_int8_roundtrip_accuracy_and_size(self, tmp_path):
+        import os
+        model, params, x, y = self._trained_mlp()
+        ref = np.asarray(model(params, x))
+        acc_f = float((ref.argmax(-1) == np.asarray(y)).mean())
+
+        d8 = str(tmp_path / "int8")
+        df = str(tmp_path / "float")
+        inference.save_inference_model(
+            d8, lambda p, a: model(p, a), params, [x],
+            weight_quantize="int8")
+        inference.save_inference_model(
+            df, lambda p, a: model(p, a), params, [x])
+
+        pred = inference.Predictor(d8)
+        assert pred.meta["weight_quantize"] == "int8"
+        out = np.asarray(pred.run(x))
+        acc_q = float((out.argmax(-1) == np.asarray(y)).mean())
+        # per-channel int8 weight quantization: tiny accuracy drop
+        assert acc_q >= acc_f - 0.03, (acc_q, acc_f)
+        np.testing.assert_allclose(out, ref, atol=0.15)
+
+        sz8 = os.path.getsize(os.path.join(d8, "params.pkl"))
+        szf = os.path.getsize(os.path.join(df, "params.pkl"))
+        # int8 weights; f32 biases + per-channel scales cap the ratio
+        assert sz8 < szf / 2.0, (sz8, szf)
+        # frozen native artifact exists and also shrank
+        fz8 = os.path.getsize(os.path.join(d8, "__model__frozen__.stablehlo"))
+        fzf = os.path.getsize(os.path.join(df, "__model__frozen__.stablehlo"))
+        assert fz8 < fzf / 1.8, (fz8, fzf)
+
+    def test_qat_frozen_params_store_exactly(self, tmp_path):
+        """qat_convert output sits on the abs-max int8 grid, so the int8
+        serving artifact reproduces it bit-for-bit (freeze parity)."""
+        from paddle_tpu import slim
+        model, params, x, _ = self._trained_mlp()
+        frozen = slim.qat_convert(params, bit_length=8, channel_wise=True)
+        q = slim.quantize_weights_int8(frozen)
+        deq = slim.dequantize_weights(q)
+        for a, b in zip(jax.tree_util.tree_leaves(frozen),
+                        jax.tree_util.tree_leaves(deq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+        d = str(tmp_path / "qat8")
+        inference.save_inference_model(
+            d, lambda p, a: model(p, a), frozen, [x],
+            weight_quantize="int8")
+        out = np.asarray(inference.Predictor(d).run(x))
+        ref = np.asarray(model(frozen, x))
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+    def test_rejects_unknown_mode(self, tmp_path):
+        import pytest
+        model, params, x, _ = self._trained_mlp()
+        with pytest.raises(ValueError, match="weight_quantize"):
+            inference.save_inference_model(
+                str(tmp_path / "bad"), lambda p, a: model(p, a),
+                params, [x], weight_quantize="int4")
