@@ -79,7 +79,14 @@ def acquire_device():
 
     Returns (device, error_string_or_None). error is set when the TPU never
     came up and we degraded to CPU.
+
+    An explicit JAX_PLATFORMS=cpu skips the TPU probe entirely (local
+    smoke runs shouldn't wait out the tunnel-retry schedule).
     """
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices("cpu")[0], None
     last_err = None
     for i, backoff in enumerate([0] + BACKOFFS):
         if backoff:
@@ -258,30 +265,245 @@ def run_bench(dev):
     }
 
 
+def run_bench_transformer(dev):
+    """Transformer-big WMT en-de, packed variable-length training
+    (BASELINE config[3]): REAL (non-pad) tokens/s/chip through the packed
+    path, with the padded one-sequence-per-row layout timed on the same
+    compiled shapes as the contrast — ``packed_vs_padded`` is the
+    measured win of data/packing.py (same step wall-clock, more real
+    tokens per slab). MFU from XLA's cost analysis of the packed step."""
+    import numpy as np
+
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.data import packing
+    from paddle_tpu.models.transformer import Transformer, TransformerConfig
+    from paddle_tpu.train import build_train_step, make_train_state
+
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig.big(dropout=0.0, attn_dropout=0.0,
+                                    vocab_size=32768, max_len=256)
+        src_len = tgt_len = 256
+        rows = 16
+        steps = 12
+        n_pairs = 1500
+    else:
+        cfg = TransformerConfig.tiny(dropout=0.0, attn_dropout=0.0,
+                                     max_len=32, attn_impl="xla")
+        src_len = tgt_len = 32
+        rows = 2
+        steps = 2
+        n_pairs = 40
+
+    model = Transformer(cfg)
+    optimizer = opt.Adam(learning_rate=1e-4)
+    state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+    # WMT-like ragged lengths: lognormal, clipped to the bucket
+    rng = np.random.default_rng(0)
+    lens = np.clip(rng.lognormal(3.0, 0.6, n_pairs).astype(np.int64),
+                   4, src_len - 1)
+    srcs = [rng.integers(3, cfg.vocab_size, n).astype(np.int32)
+            for n in lens]
+    tins = [np.concatenate([[cfg.bos_id], s]).astype(np.int32)[:tgt_len]
+            for s in srcs]
+    touts = [np.concatenate([s, [cfg.eos_id]]).astype(np.int32)[:tgt_len]
+             for s in srcs]
+
+    def loss_fn(params, **b):
+        return model.loss_packed(
+            params, b["src"], b["src_seg"], b["src_pos"], b["tgt"],
+            b["tgt_out"], b["tgt_seg"], b["tgt_pos"], training=True)
+
+    policy = dtypes.get_policy("bf16") if on_tpu else None
+    step = jax.jit(build_train_step(loss_fn, optimizer, policy=policy),
+                   donate_argnums=(0,))
+
+    def batch_stream(packed: bool):
+        if packed:
+            it = packing.packed_batches(
+                srcs, tins, rows_per_batch=rows, src_len=src_len,
+                tgt_len=tgt_len, tgt_extras={"tgt_out": touts})
+        else:
+            # one sequence per row, same compiled shapes (the LoD-free
+            # padded layout the reference trains on)
+            def padded():
+                for lo in range(0, len(srcs), rows):
+                    chunk = list(range(lo, min(lo + rows, len(srcs))))
+                    b = {k: np.zeros((rows, src_len if "src" in k
+                                      else tgt_len), np.int32)
+                         for k in ("src", "src_seg", "src_pos", "tgt",
+                                   "tgt_seg", "tgt_pos", "tgt_out")}
+                    for ri, i in enumerate(chunk):
+                        s, ti, to = srcs[i], tins[i], touts[i]
+                        b["src"][ri, :len(s)] = s
+                        b["src_seg"][ri, :len(s)] = 1
+                        b["src_pos"][ri, :len(s)] = np.arange(len(s))
+                        b["tgt"][ri, :len(ti)] = ti
+                        b["tgt_seg"][ri, :len(ti)] = 1
+                        b["tgt_pos"][ri, :len(ti)] = np.arange(len(ti))
+                        b["tgt_out"][ri, :len(to)] = to
+                    yield b
+            it = padded()
+        for b in it:
+            yield {k: jnp.asarray(v) for k, v in b.items()}
+
+    def timed(packed: bool, st):
+        import itertools
+        batches = list(itertools.islice(batch_stream(packed), steps + 1))
+        real = sum(int((np.asarray(b["tgt_seg"]) > 0).sum())
+                   for b in batches[1:])
+        slots = sum(b["tgt_seg"].size for b in batches[1:])
+        st, m = step(st, **batches[0])     # warmup/compile
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            st, m = step(st, **b)
+        loss = float(m["loss"])
+        dt = time.perf_counter() - t0
+        return real / dt, dt / len(batches[1:]), real / slots, loss, st
+
+    try:
+        first = next(batch_stream(False))   # shapes only; avoids a
+        cost = step.lower(state, **first).compile().cost_analysis()  # full
+        flops_per_step = float(cost["flops"])                 # pack pass
+    except Exception:
+        flops_per_step = 0.0
+
+    packed_tps, step_s, eff, loss, state = timed(True, state)
+    padded_tps, _, _, _, _ = timed(False, state)
+
+    mfu = (flops_per_step / step_s / device_peak_flops(dev)
+           if flops_per_step else 0.0)
+    return {
+        "metric": "transformer_big_packed_tokens_per_sec_per_chip",
+        "value": round(packed_tps, 2),
+        "unit": "real tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if mfu else 0.0,
+        "mfu": round(mfu, 4),
+        "packed_vs_padded": round(packed_tps / max(padded_tps, 1e-9), 4),
+        "padded_tokens_per_sec": round(padded_tps, 2),
+        "packing_efficiency": round(eff, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "rows_per_batch": rows,
+        "src_len": src_len,
+        "loss": round(loss, 4),
+    }
+
+
+def run_bench_deepfm(dev):
+    """DeepFM CTR with the host-resident KV embedding engine (BASELINE
+    config[4]): examples/s/chip with pull/push PREFETCH overlap on, and
+    the same stream with overlap off — ``vs_baseline`` is the measured
+    prefetch speedup, the number behind parallel/host_kv.py's "prefetch
+    overlaps the device step" design claim."""
+    import numpy as np
+
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.deepfm import DeepFMHostKV
+    from paddle_tpu.parallel.host_kv import (HostKVEmbedding, HostKVStore,
+                                             build_kv_train_step,
+                                             run_kv_epoch)
+
+    on_tpu = dev.platform == "tpu"
+    fields = 26                           # criteo-style sparse fields
+    dim = 16 if on_tpu else 8
+    # CPU smoke needs non-trivial work per batch too: when the "device"
+    # step is near-instant the prefetch thread's sync overhead swamps the
+    # overlap and the ratio is meaningless
+    batch = 4096 if on_tpu else 2048
+    n_batches = 24 if on_tpu else 8
+    vocab = 2_000_000 if on_tpu else 500_000
+
+    model = DeepFMHostKV(num_fields=fields, embed_dim=dim,
+                         hidden=(400, 400) if on_tpu else (64, 64))
+    optimizer = opt.Adam(learning_rate=1e-3)
+    params = model.init(jax.random.PRNGKey(0))
+    state0 = {"params": params, "opt": optimizer.init(params),
+              "step": jnp.zeros((), jnp.int32)}
+    step = jax.jit(build_kv_train_step(
+        lambda p, rows, inv, label: model.loss(p, rows, inv, label),
+        optimizer))
+
+    rng = np.random.default_rng(0)
+    all_batches = []
+    for _ in range(n_batches):
+        # zipf-ish skew: a hot head + a heavy uniform tail, like CTR logs
+        hot = rng.integers(0, 1000, size=(batch, fields // 2))
+        tail = rng.integers(1000, vocab,
+                            size=(batch, fields - fields // 2))
+        ids = np.concatenate([hot, tail], 1).astype(np.int64)
+        label = (rng.random(batch) < 0.2).astype(np.float32)
+        all_batches.append(dict(feat_ids=ids, label=jnp.asarray(label)))
+
+    def timed(prefetch: bool):
+        store = HostKVStore(1 + dim, optimizer="adagrad", seed=0)
+        emb = HostKVEmbedding(store, lr=0.05, min_bucket=1 << 12)
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        # warmup (compile + touch the hot rows once)
+        state, _ = run_kv_epoch(step, state, emb, iter(all_batches[:1]),
+                                ids_key="feat_ids", prefetch=prefetch)
+        t0 = time.perf_counter()
+        state, hist = run_kv_epoch(step, state, emb, iter(all_batches),
+                                   ids_key="feat_ids", prefetch=prefetch)
+        dt = time.perf_counter() - t0
+        loss = float(np.mean([float(m["loss"]) for m in hist]))
+        return batch * n_batches / dt, loss
+
+    eps_on, loss = timed(prefetch=True)
+    eps_off, _ = timed(prefetch=False)
+    return {
+        "metric": "deepfm_examples_per_sec_per_chip",
+        "value": round(eps_on, 2),
+        "unit": "examples/s/chip",
+        # the overlap claim, quantified: >1.0 == prefetch hides KV time
+        "vs_baseline": round(eps_on / max(eps_off, 1e-9), 4),
+        "prefetch_speedup": round(eps_on / max(eps_off, 1e-9), 4),
+        "examples_per_sec_no_prefetch": round(eps_off, 2),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "batch_size": batch,
+        "fields": fields,
+        "embed_dim": dim,
+        "loss": round(loss, 4),
+    }
+
+
+_BENCHES = {
+    "bert": (run_bench, "bert_base_tokens_per_sec_per_chip",
+             "tokens/s/chip"),
+    "resnet50": (run_bench_resnet, "resnet50_images_per_sec_per_chip",
+                 "images/s/chip"),
+    "transformer": (run_bench_transformer,
+                    "transformer_big_packed_tokens_per_sec_per_chip",
+                    "real tokens/s/chip"),
+    "deepfm": (run_bench_deepfm, "deepfm_examples_per_sec_per_chip",
+               "examples/s/chip"),
+}
+
+
 def main():
-    # --model bert (default, the driver's headline metric) | resnet50.
-    # Either way EXACTLY ONE JSON line goes to stdout (even on bad args).
+    # --model bert (default, the driver's headline metric) | resnet50 |
+    # transformer | deepfm. Either way EXACTLY ONE JSON line goes to
+    # stdout (even on bad args).
     which = "bert"
     try:
         if "--model" in sys.argv:
             which = sys.argv[sys.argv.index("--model") + 1]
-        if which not in ("bert", "resnet50"):
+        if which not in _BENCHES:
             raise ValueError(f"unknown --model {which!r} "
-                             "(expected bert|resnet50)")
+                             f"(expected {'|'.join(_BENCHES)})")
         dev, degraded = acquire_device()
-        result = (run_bench_resnet(dev) if which == "resnet50"
-                  else run_bench(dev))
+        result = _BENCHES[which][0](dev)
         if degraded:
             result["error"] = degraded
             result["vs_baseline"] = 0.0
     except Exception as e:  # fail-soft: always emit a parseable line, rc=0
+        fn, metric, unit = _BENCHES.get(which, _BENCHES["bert"])
         result = {
-            "metric": ("resnet50_images_per_sec_per_chip"
-                       if which == "resnet50"
-                       else "bert_base_tokens_per_sec_per_chip"),
+            "metric": metric,
             "value": 0.0,
-            "unit": ("images/s/chip" if which == "resnet50"
-                     else "tokens/s/chip"),
+            "unit": unit,
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
         }

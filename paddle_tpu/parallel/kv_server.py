@@ -74,8 +74,10 @@ class KVServer:
 
 
 class _Conn:
-    def __init__(self, host, port):
-        self.sock = socket.create_connection((host, port))
+    def __init__(self, host, port, timeout: Optional[float] = None):
+        # timeout covers connect AND each recv (liveness probes must not
+        # block through the TCP retry schedule on a partitioned server)
+        self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def request(self, op: int, n: int, payload: bytes,
@@ -211,12 +213,67 @@ class RemoteKVStore:
         if self._call(OP_LOAD, len(p), p, 1) != b"\x01":
             raise IOError(f"remote kv_load({path}) failed")
 
+    def ping(self, timeout: float = 2.0) -> bool:
+        """Liveness probe: one cheap size round-trip on a FRESH, timed
+        connection (pooled sockets can look alive after a server death
+        until their next use; a hung/partitioned server must time out,
+        not block the watchdog)."""
+        try:
+            c = _Conn(self._host, self._port, timeout=timeout)
+            try:
+                c.request(OP_SIZE, 0, b"", 8)
+                return True
+            finally:
+                c.close()
+        except OSError:
+            return False
+
     def close(self):
         self._executor.shutdown(wait=True)
         with self._pool_lock:
             for c in self._pool:
                 c.close()
             self._pool = []
+
+
+class PSMonitor:
+    """Parameter-server liveness watchdog — the pserver half of the
+    reference's failure detection (heart_beat_monitor.cc:57 tracks
+    worker beats on the pserver; trainers learn of a dead pserver from
+    failed RPC). Pings the remote store every ``check_every_s``; after
+    ``misses`` consecutive failures calls ``on_lost()`` once and stops.
+    Compose with fleet.ElasticCoordinator (or any restart policy) to
+    respawn a pserver and :meth:`RemoteKVStore.load` its last snapshot.
+    """
+
+    def __init__(self, store: "RemoteKVStore", *, check_every_s: float = 1.0,
+                 misses: int = 2, on_lost=None, log_fn=print):
+        self._store = store
+        self._stop = threading.Event()
+        self.lost = threading.Event()
+
+        def watch():
+            failed = 0
+            while not self._stop.wait(check_every_s):
+                if self._store.ping(timeout=max(0.5, check_every_s)):
+                    failed = 0
+                    continue
+                failed += 1
+                if failed >= misses:
+                    log_fn(f"[ps-monitor] pserver "
+                           f"{self._store._host}:{self._store._port} "
+                           f"lost ({failed} failed pings)")
+                    self.lost.set()
+                    if on_lost is not None:
+                        on_lost()
+                    return
+
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
 
 
 class _RemoteHandle:

@@ -397,6 +397,12 @@ def gpipe_layer_stack(
                          f"pp_microbatches={M}")
     stacked = (stack_layer_params(list(params_list))
                if isinstance(params_list, (list, tuple)) else params_list)
+    if pre_interleaved and schedule != "circular":
+        raise ValueError(
+            "pre_interleaved params hold the circular schedule's layer "
+            "order; running them through schedule="
+            f"{schedule!r} would apply layers in the wrong order — "
+            "convert back with uninterleave_stack first")
     has_keys = layer_keys is not None and layer_keys[0] is not None
     if has_keys:
         lkeys = jnp.stack(list(layer_keys))

@@ -168,3 +168,92 @@ class TestPserverProcess:
         finally:
             proc.terminate()
             proc.wait(timeout=30)
+
+
+class TestPserverFaultInjection:
+    """Kill the pserver mid-training; detection via PSMonitor pings and
+    elastic recovery from the KV snapshot (composes the heartbeat and
+    snapshot pieces the way heart_beat_monitor.cc + checkpoint_notify do
+    in the reference)."""
+
+    def _train_epochs(self, state, step, emb, n_epochs, rng, seed_base=0):
+        from paddle_tpu.parallel.host_kv import run_kv_epoch
+
+        def batches():
+            for _ in range(6):
+                hot = rng.integers(0, 32, size=(64, 1))
+                tail = rng.integers(32, 3000, size=(64, 4))
+                ids = np.concatenate([hot, tail], 1).astype(np.int64)
+                label = (hot[:, 0] < 16).astype(np.float32)
+                yield dict(feat_ids=ids, label=jnp.asarray(label))
+
+        losses = []
+        for _ in range(n_epochs):
+            state, hist = run_kv_epoch(step, state, emb, batches(),
+                                       ids_key="feat_ids", prefetch=True)
+            losses.append(np.mean([float(m["loss"]) for m in hist]))
+        return state, losses
+
+    def test_kill_detect_recover_from_snapshot(self, tmp_path):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.deepfm import DeepFMHostKV
+        from paddle_tpu.parallel.host_kv import build_kv_train_step
+        from paddle_tpu.parallel.kv_server import PSMonitor
+
+        D = 4
+        snapshot = str(tmp_path / "kv_snapshot.bin")
+        proc, port = _spawn_pserver(1 + D)
+        store = RemoteKVStore("localhost", port)
+        monitor = PSMonitor(store, check_every_s=0.2, misses=2,
+                            log_fn=lambda *_: None)
+        try:
+            model = DeepFMHostKV(num_fields=5, embed_dim=D, hidden=(16,))
+            optimizer = opt.Adam(learning_rate=5e-3)
+            params = model.init(jax.random.PRNGKey(0))
+            state = {"params": params, "opt": optimizer.init(params),
+                     "step": jnp.zeros((), jnp.int32)}
+            step = jax.jit(build_kv_train_step(
+                lambda p, rows, inv, label: model.loss(p, rows, inv, label),
+                optimizer))
+            emb = HostKVEmbedding(store, lr=0.1, min_bucket=128)
+            rng = np.random.default_rng(0)
+
+            # healthy training, then snapshot (periodic-checkpoint analog)
+            state, losses_a = self._train_epochs(state, step, emb, 3, rng)
+            store.save(snapshot)
+            rows_before = len(store)
+            assert not monitor.lost.is_set()
+
+            # -- fault: SIGKILL the pserver mid-training ----------------
+            proc.kill()
+            proc.wait(timeout=30)
+            with pytest.raises(Exception):
+                # in-flight epoch hits the dead server and surfaces it
+                self._train_epochs(state, step, emb, 1, rng)
+            assert monitor.lost.wait(timeout=10), \
+                "PSMonitor failed to detect the dead pserver"
+
+            # -- elastic recovery: new pserver + snapshot restore -------
+            proc2, port2 = _spawn_pserver(1 + D)
+            try:
+                store2 = RemoteKVStore("localhost", port2)
+                assert len(store2) == 0
+                store2.load(snapshot)
+                assert len(store2) == rows_before
+                emb2 = HostKVEmbedding(store2, lr=0.1, min_bucket=128)
+                state, losses_b = self._train_epochs(state, step, emb2,
+                                                     2, rng)
+                # resumed training continues from the snapshot: loss keeps
+                # improving relative to the pre-crash curve, no re-warmup
+                assert losses_b[-1] < losses_a[0], (losses_a, losses_b)
+                store2.close()
+            finally:
+                proc2.terminate()
+                proc2.wait(timeout=30)
+        finally:
+            monitor.stop()
+            try:
+                store.close()
+            except Exception:
+                pass     # pool sockets died with the server
+            proc.poll() or proc.terminate()

@@ -357,6 +357,49 @@ class TestBertPipelined:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=2e-4, rtol=1e-3)
 
+    def test_circular_pre_interleaved_dropout_keys(self):
+        """training=True exercises the layer-key interleave branch: the
+        pre-interleaved layout must sample the SAME dropout masks as the
+        canonical layout (layer->key binding is layout-independent)."""
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+        from paddle_tpu.parallel.pipeline import interleave_stack
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, pp=2))
+        base = dict(self.CFG, dropout=0.3, pipeline=True,
+                    pp_microbatches=4, pp_schedule="circular",
+                    pp_circuits=2)
+        m = BertForPretraining(BertConfig.tiny(**base))
+        m_pre = BertForPretraining(BertConfig.tiny(
+            **base, pp_pre_interleaved=True))
+        params = m.init(jax.random.PRNGKey(0))
+        p_pre = dict(params)
+        p_pre["bert"] = dict(params["bert"])
+        p_pre["bert"]["encoder"] = interleave_stack(
+            params["bert"]["encoder"], 2, 2)
+        _, _, _, batch = self._models_and_batch()
+        with mesh_context(mesh):
+            l = jax.jit(lambda p, k: m.loss(
+                p, training=True, key=k, **batch)[0])(
+                    params, jax.random.PRNGKey(7))
+            l2 = jax.jit(lambda p, k: m_pre.loss(
+                p, training=True, key=k, **batch)[0])(
+                    p_pre, jax.random.PRNGKey(7))
+        assert float(l2) == pytest.approx(float(l), rel=1e-5)
+
+    def test_pre_interleaved_rejected_under_gpipe(self):
+        from paddle_tpu.core.mesh import MeshConfig, make_mesh
+        from paddle_tpu.parallel.pipeline import gpipe_layer_stack
+
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        layers = _make_layers(jax.random.PRNGKey(30), 4, 4)
+        with mesh_context(mesh):
+            with pytest.raises(ValueError, match="wrong order"):
+                gpipe_layer_stack(
+                    lambda lp, h, e, k: _block(lp, h), layers,
+                    jnp.zeros((8, 4)), num_microbatches=4,
+                    schedule="gpipe", pre_interleaved=True)
+
     def test_dropout_under_pipeline(self):
         """training=True with dropout>0 exercises the per-layer key ride
         (fold_in of the microbatch index) inside the schedule."""
