@@ -1,0 +1,27 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of PaddlePaddle
+Fluid 1.5 (see SURVEY.md at the repo root for the capability map). The
+compute path is traced Python -> XLA HLO -> pjit/GSPMD over a device mesh;
+runtime services (data feeding, inference serving) are native C++.
+"""
+
+from paddle_tpu.version import __version__
+
+from paddle_tpu import (amp, config, core, data, debug, fleet, inference,
+                        io, metrics, models, nn, ops, optimizer, parallel,
+                        profiler, train, trainer)
+from paddle_tpu.trainer import Trainer
+from paddle_tpu.config import global_config, set_flags
+from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
+from paddle_tpu.executor import CompiledProgram, Executor, Program
+from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
+
+__all__ = [
+    "__version__", "amp", "config", "core", "data", "debug", "fleet",
+    "inference", "io", "metrics", "models", "nn", "ops", "optimizer",
+    "parallel", "profiler", "train", "trainer", "Trainer",
+    "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
+    "CompiledProgram", "Executor", "Program",
+    "build_eval_step", "build_train_step", "make_train_state",
+]

@@ -1,0 +1,149 @@
+"""Automatic mixed precision: dtype policies + dynamic loss scaling.
+
+Reference mapping: ``contrib/mixed_precision/decorator.py:27``
+(``OptimizerWithMixedPrecision`` — fp16 graph rewrite via white/black op
+lists ``fp16_lists.py``, dynamic loss scaling ``decorator.py:40``, fp32
+master weights). TPU-native: bf16 is the MXU dtype and needs NO loss
+scaling (fp32-range exponent), so the default policy is just
+``dtypes.get_policy("bf16")`` applied in the train step. This module adds
+the fp16-parity pieces: :class:`DynamicLossScale` (grow/shrink on overflow,
+skip bad steps) and :func:`scaled_train_step` which wires it into a
+train-step the same way the reference decorator wraps an optimizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+
+
+@dataclasses.dataclass
+class LossScaleConfig:
+    init_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 1000   # incr_every_n_steps in the reference
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+
+
+class DynamicLossScale:
+    """Functional dynamic loss scale (decorator.py:40 semantics):
+    state = {scale, growth_counter}; on overflow the step is SKIPPED and
+    the scale backs off; after growth_interval clean steps it grows."""
+
+    def __init__(self, config: Optional[LossScaleConfig] = None):
+        self.config = config or LossScaleConfig()
+
+    def init(self):
+        return {
+            "scale": jnp.asarray(self.config.init_scale, jnp.float32),
+            "growth_counter": jnp.zeros((), jnp.int32),
+        }
+
+    def scale(self, loss, state):
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale(self, grads, state):
+        inv = 1.0 / state["scale"]
+        return jax.tree_util.tree_map(
+            lambda g: g * inv.astype(g.dtype), grads)
+
+    def grads_finite(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        ok = jnp.asarray(True)
+        for g in leaves:
+            ok = ok & jnp.all(jnp.isfinite(g))
+        return ok
+
+    def update(self, state, grads_finite):
+        cfg = self.config
+        counter = jnp.where(grads_finite, state["growth_counter"] + 1, 0)
+        grow = counter >= cfg.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, state["scale"] * cfg.growth_factor,
+                      state["scale"]),
+            state["scale"] * cfg.backoff_factor)
+        new_scale = jnp.clip(new_scale, cfg.min_scale, cfg.max_scale)
+        return {
+            "scale": new_scale,
+            "growth_counter": jnp.where(grow, 0, counter),
+        }
+
+
+def scaled_train_step(
+    loss_fn: Callable,
+    optimizer,
+    *,
+    policy: Optional[dtypes.Policy] = None,
+    loss_scale: Optional[DynamicLossScale] = None,
+) -> Callable:
+    """fp16-style train step: scaled loss, unscaled grads, skip-on-overflow.
+
+    ``step(state, **batch) -> (state, metrics)`` where state additionally
+    carries "loss_scale". Use build_train_step + a bf16 policy instead when
+    targeting bf16 (no scaling needed) — this exists for fp16 parity and
+    for fp8-era experimentation.
+    """
+    policy = policy or dtypes.get_policy("bf16")
+    loss_scale = loss_scale or DynamicLossScale()
+
+    def step(state, **batch):
+        from paddle_tpu.nn.module import apply_state_updates, capture_state
+
+        ls_state = state["loss_scale"]
+
+        def scaled_loss(params):
+            p = policy.cast_to_compute(params)
+            b = policy.cast_to_compute(batch)
+            with capture_state() as tape:  # BN running stats, as in
+                out = loss_fn(p, **b)      # build_train_step
+            loss = out[0] if isinstance(out, tuple) else out
+            aux = out[1] if isinstance(out, tuple) else {}
+            return loss_scale.scale(loss.astype(jnp.float32), ls_state), \
+                (loss, aux, dict(tape.updates))
+
+        (_, (loss, aux, updates)), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(state["params"])
+        grads = loss_scale.unscale(grads, ls_state)
+        finite = loss_scale.grads_finite(grads)
+        new_ls = loss_scale.update(ls_state, finite)
+
+        # apply only when finite (skip step on overflow)
+        applied_params, applied_opt = optimizer.update(
+            jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, 0.0), grads),
+            state["opt"], state["params"])
+        applied_params = apply_state_updates(applied_params, updates)
+        params = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(finite, new, old),
+            applied_params, state["params"])
+        opt = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(finite, new, old)
+            if hasattr(new, "dtype") else new,
+            applied_opt, state["opt"])
+
+        new_state = dict(state)
+        new_state.update(params=params, opt=opt,
+                         step=state["step"] + finite.astype(jnp.int32),
+                         loss_scale=new_ls)
+        metrics = {"loss": loss, "grads_finite": finite,
+                   "loss_scale": new_ls["scale"], **aux}
+        return new_state, metrics
+
+    return step
+
+
+def make_amp_state(model, optimizer, rng_key,
+                   loss_scale: Optional[DynamicLossScale] = None):
+    from paddle_tpu.train import make_train_state
+
+    loss_scale = loss_scale or DynamicLossScale()
+    return make_train_state(model, optimizer, rng_key,
+                            sample_extra={"loss_scale": loss_scale.init()})

@@ -1,0 +1,117 @@
+"""Single typed configuration tree.
+
+Replaces the reference's three overlapping config systems (SURVEY.md §5.6):
+gflags (``platform/flags.cc``), env-var bootstrap
+(``python/paddle/fluid/__init__.py:128``), and the pybind strategy structs
+(``BuildStrategy``/``ExecutionStrategy``/``DistributedStrategy``). One
+dataclass tree, overridable from env vars prefixed ``PADDLE_TPU_``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from paddle_tpu.core.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class ExecutionConfig:
+    """Per-step execution knobs (reference ExecutionStrategy,
+    ``details/execution_strategy.h``)."""
+
+    # Donate input buffers to jit (reference: inplace/memory-reuse passes,
+    # ``ir/memory_optimize_pass/``). XLA buffer donation subsumes those passes.
+    donate_params: bool = True
+    # Check every op output for NaN/Inf (FLAGS_check_nan_inf, operator.cc:35).
+    check_nan_inf: bool = False
+    # Deterministic compilation (FLAGS_cpu_deterministic / cudnn_deterministic).
+    deterministic: bool = False
+
+
+@dataclasses.dataclass
+class BuildConfig:
+    """Compile-time knobs (reference BuildStrategy, details/build_strategy.h).
+
+    Most BuildStrategy passes (op fusion, coalesce grads, fuse_all_reduce) are
+    XLA's job on TPU; what remains user-facing is remat and AMP policy.
+    """
+
+    amp_policy: str = "full"  # "full" | "bf16" | "bf16_full"
+    remat: bool = False  # activation recomputation (RecomputeOptimizer parity)
+    # Gradient accumulation steps (BatchMergePass / gradient-merge parity,
+    # ir/multi_batch_merge_pass.h:34).
+    grad_accum_steps: int = 1
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Mesh + collective layout (replaces DistributedStrategy and the
+    transpiler config, ``transpiler/distribute_transpiler.py:131``)."""
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # Multi-host bootstrap (replaces nccl-id exchange; jax.distributed).
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclasses.dataclass
+class Config:
+    execution: ExecutionConfig = dataclasses.field(default_factory=ExecutionConfig)
+    build: BuildConfig = dataclasses.field(default_factory=BuildConfig)
+    distributed: DistributedConfig = dataclasses.field(default_factory=DistributedConfig)
+    seed: int = 0
+
+
+_GLOBAL = Config()
+
+
+def global_config() -> Config:
+    return _GLOBAL
+
+
+def set_flags(**kwargs):
+    """Flat flag setter for parity with fluid's FLAGS_* surface.
+
+    e.g. ``set_flags(check_nan_inf=True, amp_policy="bf16")``.
+    """
+    for k, v in kwargs.items():
+        for section in (_GLOBAL.execution, _GLOBAL.build, _GLOBAL.distributed):
+            if hasattr(section, k):
+                setattr(section, k, v)
+                break
+        else:
+            if hasattr(_GLOBAL, k):
+                setattr(_GLOBAL, k, v)
+            else:
+                raise ValueError(f"unknown flag {k!r}")
+
+
+def _bootstrap_from_env():
+    """PADDLE_TPU_<FLAG>=value env overrides (parity with __bootstrap__,
+    python/paddle/fluid/__init__.py:128)."""
+    prefix = "PADDLE_TPU_"
+    for key, val in os.environ.items():
+        if not key.startswith(prefix):
+            continue
+        name = key[len(prefix):].lower()
+        parsed: object = val
+        if val.lower() in ("true", "false"):
+            parsed = val.lower() == "true"
+        else:
+            try:
+                parsed = int(val)
+            except ValueError:
+                try:
+                    parsed = float(val)
+                except ValueError:
+                    pass
+        try:
+            set_flags(**{name: parsed})
+        except ValueError:
+            pass  # unrelated env var sharing the prefix
+
+
+_bootstrap_from_env()

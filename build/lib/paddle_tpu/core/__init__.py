@@ -1,0 +1,15 @@
+"""Core abstractions: dtypes, mesh, op registry."""
+
+from paddle_tpu.core import dtypes, mesh, registry
+from paddle_tpu.core.dtypes import Policy, convert_dtype, get_policy
+from paddle_tpu.core.mesh import (MeshConfig, batch_sharding, current_mesh,
+                                  make_mesh, mesh_context, replicated,
+                                  single_device_mesh)
+from paddle_tpu.core.registry import all_ops, get_op, list_ops, register_op
+
+__all__ = [
+    "dtypes", "mesh", "registry", "Policy", "convert_dtype", "get_policy",
+    "MeshConfig", "batch_sharding", "current_mesh", "make_mesh",
+    "mesh_context", "replicated", "single_device_mesh",
+    "all_ops", "get_op", "list_ops", "register_op",
+]

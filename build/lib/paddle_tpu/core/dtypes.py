@@ -1,0 +1,106 @@
+"""Dtype registry and mixed-precision policy.
+
+TPU-native replacement for the reference's dtype plumbing:
+- ``framework/data_type.h`` / ``VarType`` dtype enum (reference
+  ``paddle/fluid/framework/framework.proto:105``) -> plain jnp dtypes.
+- ``platform/float16.h`` (hand-rolled fp16 with CUDA intrinsics) -> native
+  ``jnp.bfloat16``, the TPU MXU dtype.
+- AMP white/black lists (reference
+  ``python/paddle/fluid/contrib/mixed_precision/fp16_lists.py``) -> a single
+  :class:`Policy` describing param/compute/output dtypes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype table: string name -> jnp dtype. Mirrors the VarType enum
+# surface of the reference (bool/int8..int64/fp16/bf16/fp32/fp64).
+_DTYPES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a string/np/jnp dtype spec to a jnp dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _DTYPES:
+            raise ValueError(f"unknown dtype {dtype!r}; known: {sorted(_DTYPES)}")
+        return jnp.dtype(_DTYPES[dtype])
+    return jnp.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed-precision policy: where each dtype is used.
+
+    TPU analog of the reference AMP decorator
+    (``contrib/mixed_precision/decorator.py:27``): params stay fp32, compute
+    runs bf16 on the MXU, outputs/losses are fp32. Unlike CUDA fp16 there is
+    no loss-scaling *requirement* for bf16 (same exponent range as fp32), but
+    a DynamicLossScale is still provided in :mod:`paddle_tpu.amp` for fp16
+    parity.
+    """
+
+    param_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    compute_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+    output_dtype: jnp.dtype = jnp.dtype(jnp.float32)
+
+    def cast_to_compute(self, x):
+        return _cast_floating_tree(x, self.compute_dtype)
+
+    def cast_to_param(self, x):
+        return _cast_floating_tree(x, self.param_dtype)
+
+    def cast_to_output(self, x):
+        return _cast_floating_tree(x, self.output_dtype)
+
+
+def _cast_floating_tree(tree, dtype):
+    import jax
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, np.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+FULL = Policy()
+BF16_COMPUTE = Policy(compute_dtype=jnp.dtype(jnp.bfloat16))
+
+
+def get_policy(name: str) -> Policy:
+    """Look up a policy by name ("full", "bf16", "params_and_compute_bf16")."""
+    table = {
+        "full": FULL,
+        "float32": FULL,
+        "bf16": BF16_COMPUTE,
+        "bfloat16": BF16_COMPUTE,
+        "bf16_full": Policy(
+            param_dtype=jnp.dtype(jnp.bfloat16),
+            compute_dtype=jnp.dtype(jnp.bfloat16),
+            output_dtype=jnp.dtype(jnp.bfloat16),
+        ),
+    }
+    if name not in table:
+        raise ValueError(f"unknown policy {name!r}")
+    return table[name]

@@ -1,0 +1,142 @@
+"""Device mesh abstractions: the TPU-native replacement for Place/DeviceContext.
+
+Reference mapping:
+- ``platform/place.h:79`` (CPUPlace/CUDAPlace variant) -> a JAX device plus a
+  named position in a :class:`jax.sharding.Mesh`. There is no per-op Place
+  dispatch; XLA GSPMD places shards.
+- ``platform/nccl_helper.h`` NCCLContextMap / hierarchical-allreduce context
+  (``nccl_op_handle.h:124``) -> mesh axes. Intra-slice ICI axes vs. the
+  cross-slice DCN axis replace the 2-level NCCL ring hierarchy.
+- ``platform/collective_helper.h`` comm bootstrap (nccl-id exchange over
+  sockets, ``c_gen_nccl_id_op.cc``) -> ``jax.distributed.initialize`` +
+  ``jax.make_mesh``; no out-of-band id exchange.
+
+Canonical axis names (used by every sharding rule in paddle_tpu.parallel):
+  "dp"   data parallel            (batch dim)
+  "fsdp" fully-sharded data parallel (params sharded over this too)
+  "tp"   tensor/model parallel    (hidden dims)
+  "sp"   sequence/context parallel(sequence dim; ring attention)
+  "pp"   pipeline parallel        (layer stages)
+  "ep"   expert parallel          (MoE experts)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP = "dp"
+FSDP = "fsdp"
+TP = "tp"
+SP = "sp"
+PP = "pp"
+EP = "ep"
+
+ALL_AXES = (DP, FSDP, TP, SP, PP, EP)
+
+# Axes over which a batch is split (data sharding): used as the default
+# PartitionSpec for input batches.
+BATCH_AXES = (DP, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Typed mesh shape config (replaces nccl_comm_num / hierarchical flags)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def axis_sizes(self) -> dict:
+        return {a: getattr(self, a) for a in ALL_AXES}
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axis_sizes().values())
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Create a named mesh over the available devices.
+
+    With no arguments, builds a pure data-parallel mesh over all devices.
+    ``MeshConfig`` axes of size 1 are kept (they are free) so that sharding
+    rules can always refer to every canonical axis name.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if config is None and shape is None:
+        config = MeshConfig(dp=n)
+    if config is not None:
+        sizes = config.axis_sizes()
+        if config.size != n:
+            raise ValueError(
+                f"mesh config {sizes} needs {config.size} devices, have {n}"
+            )
+        axis_names = ALL_AXES
+        shape = tuple(sizes[a] for a in axis_names)
+    if axis_names is None:
+        raise ValueError("make_mesh(shape=...) requires axis_names")
+    if len(axis_names) != len(shape):
+        raise ValueError(f"axis_names {axis_names} vs shape {shape} length "
+                         "mismatch")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def single_device_mesh() -> Mesh:
+    """A trivial 1-device mesh (all canonical axes size 1)."""
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+_CURRENT_MESH: list = []
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    """Push a mesh as the ambient default (analogous to the reference's
+    DeviceContextPool singleton, ``platform/device_context.h:317``)."""
+    _CURRENT_MESH.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _CURRENT_MESH.pop()
+
+
+def current_mesh() -> Optional[Mesh]:
+    if _CURRENT_MESH:
+        return _CURRENT_MESH[-1]
+    return None
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Default sharding for an input batch: split dim 0 over (dp, fsdp)."""
+    return NamedSharding(mesh, P(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def device_count() -> int:
+    return jax.device_count()

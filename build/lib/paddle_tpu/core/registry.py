@@ -1,0 +1,63 @@
+"""Op registry: the TPU-native OpInfoMap.
+
+Reference mapping: ``REGISTER_OPERATOR`` / ``REGISTER_OP_*_KERNEL``
+(``framework/op_registry.h:199,234``) + ``OpInfoMap`` (``op_info.h:93``).
+On TPU there is no (place, dtype, layout, library) kernel dispatch — XLA
+compiles one lowering — so an "op" here is a JAX-traceable function plus
+metadata the framework still needs:
+
+- ``reference``: a NumPy reference implementation used by the OpTest harness
+  (parity with the python-computed expectations in
+  ``python/paddle/fluid/tests/unittests/op_test.py:135``).
+- ``has_grad``: whether grads flow (tested by finite differences, parity with
+  ``check_grad_with_place``, op_test.py:922).
+- custom VJPs are attached with ``jax.custom_vjp`` on the function itself
+  (parity with GradOpDescMaker, ``grad_op_desc_maker.h:36``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class OpInfo:
+    name: str
+    fn: Callable
+    reference: Optional[Callable] = None  # numpy reference impl
+    has_grad: bool = True
+    doc: str = ""
+
+
+_OP_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register_op(name: str, *, reference: Optional[Callable] = None,
+                has_grad: bool = True):
+    """Decorator registering an op into the global OpInfoMap."""
+
+    def wrap(fn: Callable) -> Callable:
+        if name in _OP_REGISTRY:
+            raise ValueError(f"op {name!r} already registered")
+        _OP_REGISTRY[name] = OpInfo(
+            name=name, fn=fn, reference=reference, has_grad=has_grad,
+            doc=(fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__ else "",
+        )
+        return fn
+
+    return wrap
+
+
+def get_op(name: str) -> OpInfo:
+    if name not in _OP_REGISTRY:
+        raise KeyError(f"op {name!r} not registered; have {len(_OP_REGISTRY)} ops")
+    return _OP_REGISTRY[name]
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def all_ops() -> Dict[str, OpInfo]:
+    return dict(_OP_REGISTRY)

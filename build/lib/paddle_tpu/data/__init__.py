@@ -1,0 +1,6 @@
+"""Data ingestion: reader combinators, synthetic datasets, device feeding."""
+
+from paddle_tpu.data import datasets, reader
+from paddle_tpu.data.feeder import DataFeeder, device_iterator
+
+__all__ = ["datasets", "reader", "DataFeeder", "device_iterator"]

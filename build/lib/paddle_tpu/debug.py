@@ -1,0 +1,148 @@
+"""Numeric debugging: NaN/Inf checks, determinism knobs.
+
+Reference mapping (SURVEY.md §5.2): ``FLAGS_check_nan_inf`` validates every
+op output (operator.cc:35,840), ``FLAGS_fast_check_nan_inf`` (operator.cc:37)
+is the cheap variant, ``FLAGS_cpu_deterministic``/``cudnn_deterministic``
+pin reductions. TPU-native:
+- :func:`enable_nan_checks` → ``jax.debug_nans`` (XLA re-runs the failing
+  computation op-by-op and points at the op — better than the reference's
+  per-op scan, same contract).
+- :func:`check_numerics` → explicit in-graph assertion via checkify for
+  always-on production guards (fast_check_nan_inf analog).
+- determinism: XLA on TPU is deterministic by construction; dropout keys
+  are explicit, so there is no cudnn_deterministic analog needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def enable_nan_checks(enable: bool = True):
+    """Global NaN trap (FLAGS_check_nan_inf parity)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+def check_numerics(tree: Any, label: str = "tensor") -> Any:
+    """In-graph guard: error (under checkify) if any leaf has NaN/Inf.
+    Returns the tree unchanged, so it can be inserted mid-computation."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            name = label + jax.tree_util.keystr(path)
+            checkify.check(jnp.all(jnp.isfinite(leaf)),
+                           "non-finite values in {}".format(name))
+    return tree
+
+
+def checked(fn):
+    """Wrap a jittable fn so checkify.check assertions become returned
+    errors: ``err, out = checked(step)(...)``; ``err.throw()`` raises."""
+    return checkify.checkify(fn)
+
+
+def finite_or_zero(x):
+    """Scrub non-finite values (grad-scrubbing util for AMP overflow
+    handling — the reference's loss-scaling path skips steps instead)."""
+    return jnp.where(jnp.isfinite(x), x, 0.0)
+
+
+def print_program(fn, *example_args, stage="jaxpr", **example_kwargs):
+    """Program pretty-printer (``debugger.py`` ``draw_block_graphviz`` /
+    program printer parity). The "Program IR" of this framework is the
+    traced computation: ``stage="jaxpr"`` prints the closed jaxpr (op-level
+    view ≙ ProgramDesc blocks/ops), ``stage="hlo"`` the optimized-ready
+    StableHLO text XLA compiles (graph-IR view ≙ ir::Graph dumps).
+    Returns the string (and prints it)."""
+    import jax
+
+    if stage == "jaxpr":
+        text = str(jax.make_jaxpr(fn)(*example_args, **example_kwargs))
+    elif stage == "hlo":
+        text = jax.jit(fn).lower(
+            *example_args, **example_kwargs).as_text()
+    else:
+        raise ValueError(f"stage must be 'jaxpr' or 'hlo', got {stage!r}")
+    print(text)
+    return text
+
+
+def program_to_dot(fn, *example_args, max_nodes=200, **example_kwargs):
+    """Graphviz dot of the traced program (``net_drawer.py`` /
+    ``graph_viz_pass.cc`` parity): one node per jaxpr equation, edges along
+    var def->use. Returns the dot source string."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs).jaxpr
+    lines = ["digraph program {", "  rankdir=TB;",
+             "  node [shape=box, fontsize=10];"]
+    producers = {}
+    for i, eqn in enumerate(jaxpr.eqns[:max_nodes]):
+        label = eqn.primitive.name
+        lines.append(f'  op{i} [label="{label}"];')
+        for v in eqn.outvars:
+            producers[str(v)] = i
+    for i, eqn in enumerate(jaxpr.eqns[:max_nodes]):
+        for v in eqn.invars:
+            src = producers.get(str(v))
+            if src is not None and src != i:
+                lines.append(f"  op{src} -> op{i};")
+    if len(jaxpr.eqns) > max_nodes:
+        lines.append(f'  trunc [label="... {len(jaxpr.eqns) - max_nodes} '
+                     f'more ops", style=dashed];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def op_frequency(fn, *example_args, **example_kwargs):
+    """Count primitive frequencies in a traced program
+    (``contrib/op_frequence.py`` parity): {primitive_name: count},
+    sorted dict by descending count."""
+    import collections
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs).jaxpr
+    counts = collections.Counter()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                # nested programs hide in single params (scan's "jaxpr")
+                # AND in tuples of them (cond's "branches")
+                for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr)
+        return counts
+
+    walk(jaxpr)
+    return dict(sorted(counts.items(), key=lambda kv: -kv[1]))
+
+
+def estimate_memory(fn, *example_args, **example_kwargs):
+    """Peak-memory / traffic estimate for a jitted function
+    (``contrib/memory_usage_calc.py`` parity, but from the compiler
+    itself): returns {"argument_bytes", "output_bytes",
+    "temp_bytes", "generated_code_bytes", "total_bytes"} from XLA's
+    compiled memory analysis — the authoritative number, not a
+    shape-walk approximation."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*example_args, **example_kwargs).compile()
+    m = compiled.memory_analysis()
+    if m is None:                                  # backend w/o analysis
+        return None
+    out = {
+        "argument_bytes": int(getattr(m, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(m, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(m, "temp_size_in_bytes", 0)),
+        "generated_code_bytes": int(
+            getattr(m, "generated_code_size_in_bytes", 0)),
+    }
+    out["total_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                          + out["temp_bytes"])
+    return out

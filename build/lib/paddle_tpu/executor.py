@@ -1,0 +1,212 @@
+"""Executor + Program: the run-a-model facade.
+
+Reference mapping:
+- ``Executor`` (``python/paddle/fluid/executor.py:418``, C++ hot loop
+  ``executor.cc:437``) interprets a ProgramDesc op-by-op. The TPU-native
+  equivalent compiles the whole step with XLA once and replays it:
+  :class:`Program` wraps a traced step function; :class:`Executor` feeds
+  host arrays, runs the compiled executable, fetches host results.
+- ``CompiledProgram.with_data_parallel`` (``compiler.py:138``) + the
+  AllReduce SSA-graph machinery → :meth:`Program.compile` with a mesh:
+  pjit/GSPMD shards the batch over ``(dp, fsdp)`` axes; gradient allreduce
+  is inserted by XLA, replacing AllReduceOpHandle (details/
+  all_reduce_op_handle.cc:127).
+- feed/fetch ops (``controlflow/feed_op.cc``) → named kwargs and returned
+  pytrees; no graph mutation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+
+
+@dataclasses.dataclass
+class Program:
+    """A step function + metadata; the ProgramDesc analog (serializable via
+    paddle_tpu.inference.export to StableHLO rather than protobuf).
+
+    ``fn(state, **feeds) -> (state, fetches)`` for train programs, or
+    ``fn(params, **feeds) -> fetches`` for inference; the Executor doesn't
+    care — it passes state through if the output is a 2-tuple with the same
+    structure.
+    """
+
+    fn: Callable
+    name: str = "program"
+    # Donate the state buffers to the compiled step (train programs should
+    # set True for in-place param updates; False is the safe default so an
+    # inference program can be called repeatedly with the same params).
+    donate_state: bool = False
+    # Sharding: feed arrays get batch sharding over (dp, fsdp) unless listed
+    # in `replicated_feeds`.
+    replicated_feeds: Sequence[str] = ()
+    # Placement for the state argument under a mesh: a pytree of
+    # PartitionSpecs or NamedShardings matching the state (e.g. from
+    # ShardingPlan.state_specs). Part of the Program — the reference's
+    # ProgramDesc likewise carries placement — so Executor.run uses it
+    # without extra plumbing.
+    state_shardings: Any = None
+
+    def compile(self, mesh: Optional[Mesh] = None,
+                state_shardings: Any = None) -> "CompiledProgram":
+        if state_shardings is None:
+            state_shardings = self.state_shardings
+        return CompiledProgram(self, mesh, state_shardings)
+
+
+class CompiledProgram:
+    """jit/pjit-compiled program bound to a mesh (CompiledProgram parity)."""
+
+    def __init__(self, program: Program, mesh: Optional[Mesh] = None,
+                 state_shardings: Any = None):
+        self.program = program
+        self.mesh = mesh
+        self._batch_sharding = (mesh_lib.batch_sharding(mesh)
+                                if mesh is not None else None)
+        self._replicated = (mesh_lib.replicated(mesh)
+                            if mesh is not None else None)
+        donate = (0,) if program.donate_state else ()
+        self.state_shardings = None
+        if mesh is not None and state_shardings is not None:
+            # accept PartitionSpec leaves and bind them to the mesh
+            self.state_shardings = jax.tree_util.tree_map(
+                lambda s: (NamedSharding(mesh, s)
+                           if isinstance(s, P) else s),
+                state_shardings,
+                is_leaf=lambda x: isinstance(x, (P, NamedSharding)))
+        elif mesh is not None and mesh.size > 1:
+            import warnings
+            warnings.warn(
+                f"Program '{program.name}' compiled for a {mesh.size}-"
+                "device mesh WITHOUT state_shardings: the state will be "
+                "fully replicated on every device. Pass "
+                "Program(state_shardings=...) (e.g. from "
+                "ShardingPlan.state_specs) to shard it.",
+                stacklevel=3)
+        self._fn = jax.jit(program.fn, donate_argnums=donate)
+
+    def __call__(self, state, **feeds):
+        if self.mesh is not None:
+            feeds = {
+                k: jax.device_put(
+                    v, self._replicated
+                    if k in self.program.replicated_feeds
+                    else self._batch_sharding)
+                for k, v in feeds.items()
+            }
+            if self.state_shardings is not None and state is not None:
+                # committed placement drives GSPMD; a no-op when the state
+                # already sits on these shardings (the steady-state train
+                # loop: donated outputs come back correctly placed)
+                state = jax.device_put(state, self.state_shardings)
+        return self._fn(state, **feeds)
+
+
+def _dataset_batches(dataset, batch_size, feed_builder, drop_last=False):
+    """Iterate batches from either a native MultiSlotDataset (its
+    ``batches`` stream) or a python reader creator (callable yielding
+    samples, batched here). Reader creators REQUIRE ``feed_builder`` —
+    the Executor feeds keyword dicts, not raw sample lists."""
+    if hasattr(dataset, "batches"):
+        yield from dataset.batches(batch_size, drop_last=drop_last)
+        return
+    if feed_builder is None:
+        raise ValueError(
+            "reader-creator datasets need feed_builder(samples) -> feed "
+            "dict (native MultiSlotDataset batches pass through as-is)")
+    buf = []
+    for sample in dataset():
+        buf.append(sample)
+        if len(buf) == batch_size:
+            yield feed_builder(buf)
+            buf = []
+    if buf and not drop_last:
+        yield feed_builder(buf)      # trailing partial batch is NOT lost
+
+
+class Executor:
+    """Feed/fetch runner (fluid Executor parity: run(program, feed, fetch)).
+
+    ``place`` is kept for API familiarity but is advisory — placement is the
+    mesh's job.
+    """
+
+    def __init__(self, place=None, mesh: Optional[Mesh] = None):
+        self.place = place
+        self.mesh = mesh
+        self._cache: Dict[int, tuple] = {}
+
+    def run(self, program, state=None, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[str]] = None, return_numpy=True):
+        """Run one step. ``fetch_list`` selects keys out of a dict result
+        (fluid fetch parity); None returns everything."""
+        feed = feed or {}
+        if isinstance(program, Program):
+            # Keyed by id but the cache holds a strong ref to the Program, so
+            # an address can't be recycled while its entry is alive.
+            key = id(program)
+            if key not in self._cache:
+                self._cache[key] = (program, program.compile(self.mesh))
+            cached_prog, compiled = self._cache[key]
+            assert cached_prog is program
+        else:
+            compiled = program
+        out = compiled(state, **feed)
+        if isinstance(out, tuple) and len(out) == 2:
+            state, fetches = out
+        else:
+            fetches = out
+        if fetch_list and isinstance(fetches, dict):
+            fetches = {k: fetches[k] for k in fetch_list}
+        if return_numpy:
+            fetches = jax.tree_util.tree_map(np.asarray, jax.device_get(fetches))
+        return state, fetches
+
+    def train_from_dataset(self, program, dataset, state, *,
+                           batch_size=64, epochs=1, feed_builder=None,
+                           fetch_handler=None):
+        """Dataset-path training (fluid executor.py:1101
+        ``train_from_dataset`` → ``Executor::RunFromDataset``,
+        executor.cc:168): run ``program`` over every batch of ``dataset``
+        for ``epochs``. The reference spawns device-worker threads pulling
+        parsed records from the DataFeed channel; here the native feed (or
+        a reader creator) streams host batches into one jitted program —
+        XLA owns the device parallelism. ``feed_builder(samples) -> feed``
+        adapts raw reader samples; ``fetch_handler(step, fetches)``
+        observes results (PrintFetchVars parity). Returns (state, last
+        fetches)."""
+        fetches = None
+        step_i = 0
+        for _ in range(epochs):
+            # training drops the ragged tail (a different batch shape
+            # would trigger a recompile for one step per epoch)
+            for batch in _dataset_batches(dataset, batch_size,
+                                          feed_builder, drop_last=True):
+                state, fetches = self.run(program, state, feed=batch,
+                                          return_numpy=False)
+                if fetch_handler is not None:
+                    fetch_handler(step_i, fetches)
+                step_i += 1
+        return state, fetches
+
+    def infer_from_dataset(self, program, dataset, state, *,
+                           batch_size=64, feed_builder=None):
+        """Forward-only dataset pass (fluid infer_from_dataset parity):
+        collects per-batch fetches into a list."""
+        outs = []
+        for batch in _dataset_batches(dataset, batch_size, feed_builder):
+            _, fetches = self.run(program, state, feed=batch,
+                                  return_numpy=True)
+            outs.append(fetches)
+        return outs
+
+    def close(self):
+        self._cache.clear()
+

@@ -1,0 +1,258 @@
+"""Fleet: multi-host training bootstrap + role management.
+
+Reference mapping (SURVEY.md §2.6): the ``Fleet`` facade
+(``incubate/fleet/base/fleet_base.py:38`` init/init_worker/init_server),
+role makers (``role_maker.py`` — ``PaddleCloudRoleMaker:328`` reads
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env vars; ``MPISymetricRoleMaker``)
+and the nccl-id bootstrap (``c_gen_nccl_id_op.cc`` socket exchange).
+
+TPU-native: there are no pserver/trainer roles — every host is a worker in
+one SPMD program. Bootstrap is ``jax.distributed.initialize`` (the JAX
+coordination service replaces the nccl-id exchange); role queries map to
+process_index/process_count; ``DistributedStrategy`` becomes the typed
+(MeshConfig, ShardingPlan, Policy) triple.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class RoleMaker:
+    """Resolved distributed identity (role_maker.py parity, minus
+    pserver roles)."""
+
+    worker_index: int = 0
+    worker_num: int = 1
+    coordinator: Optional[str] = None
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index == 0
+
+    @classmethod
+    def from_env(cls) -> "RoleMaker":
+        """PaddleCloud-style env bootstrap (PADDLE_* honored for parity;
+        JAX_* / TPU pod env preferred)."""
+        idx = int(os.environ.get("JAX_PROCESS_INDEX",
+                                 os.environ.get("PADDLE_TRAINER_ID", "0")))
+        num = int(os.environ.get("JAX_PROCESS_COUNT",
+                                 os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS",
+                               os.environ.get("PADDLE_COORDINATOR", None))
+        return cls(idx, num, coord)
+
+
+_INITIALIZED = False
+
+
+def init(role: Optional[RoleMaker] = None) -> RoleMaker:
+    """Initialize multi-host JAX (Fleet.init parity).
+
+    Single-process (worker_num == 1) is a no-op; multi-process calls
+    ``jax.distributed.initialize`` — the coordination service replaces the
+    reference's out-of-band nccl-id/gRPC bootstrap. On TPU pods with
+    standard env, argument-less initialize() autodetects everything.
+    """
+    global _INITIALIZED
+    role = role or RoleMaker.from_env()
+    if role.worker_num > 1 and not _INITIALIZED:
+        jax.distributed.initialize(
+            coordinator_address=role.coordinator,
+            num_processes=role.worker_num,
+            process_id=role.worker_index)
+        _INITIALIZED = True
+    return role
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def is_first_worker() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "fleet"):
+    """Cross-host sync point (fleet_util barrier parity)."""
+    from paddle_tpu.parallel import collective
+    from paddle_tpu.core.mesh import current_mesh, make_mesh
+
+    mesh = current_mesh() or make_mesh()
+    collective.barrier(axis=tuple(mesh.axis_names), mesh=mesh)
+
+
+class HeartbeatMonitor:
+    """Training-stall watchdog (operators/distributed/heart_beat_monitor.h:54
+    ``LostWorkerMonitor`` parity — there: pserver tracks per-worker update
+    times; here: a host thread tracks step progress and calls ``on_stall``
+    when no beat arrives within the timeout)."""
+
+    def __init__(self, timeout_s: float = 300.0, *, check_every_s: float = 10.0,
+                 on_stall=None, log_fn=print):
+        import threading
+        import time as _time
+
+        self.timeout_s = timeout_s
+        self._last = _time.monotonic()
+        self._step = -1
+        self._stop = threading.Event()
+        self._on_stall = on_stall
+        self._log = log_fn
+
+        def watch():
+            while not self._stop.wait(check_every_s):
+                idle = _time.monotonic() - self._last
+                if idle > self.timeout_s:
+                    msg = (f"[heartbeat] no progress for {idle:.0f}s "
+                           f"(last step {self._step})")
+                    self._log(msg)
+                    if self._on_stall is not None:
+                        self._on_stall(self._step, idle)
+
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+
+    def beat(self, step: int):
+        import time as _time
+
+        self._last = _time.monotonic()
+        self._step = step
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticCoordinator:
+    """Worker-process supervisor: spawn N ranks, watch for failures,
+    respawn crashed ranks (same rank id) until the job finishes or the
+    restart budget is spent.
+
+    Reference mapping (SURVEY.md §5.3): fluid's fault tolerance pairs the
+    pserver-side LostWorkerMonitor (heart_beat_monitor.h:54) with
+    cloud-side restart policy; here detection is HeartbeatMonitor /
+    process exit, and THIS is the restart policy half: a host-side
+    coordinator owning the worker processes. Workers are expected to
+    resume from their latest checkpoint on restart (io.CheckpointManager
+    pattern — see tests/test_dist_multiprocess.py for the full loop).
+
+    ``spawn_fn(rank, attempt) -> subprocess.Popen`` creates a worker;
+    ``success_rc`` exits that count as done; every other exit triggers a
+    respawn while ``max_restarts`` allows.
+
+    ``gang=True`` (default): ANY failure kills every worker and respawns
+    the whole gang at attempt+1 — required for SPMD jobs, where a
+    ``jax.distributed`` coordination service cannot admit a lone
+    rejoining rank; training resumes from the latest checkpoint.
+    ``gang=False`` restarts ranks individually (independent workers,
+    e.g. pserver clients).
+    """
+
+    def __init__(self, spawn_fn, num_workers: int, *,
+                 max_restarts: int = 2, poll_s: float = 0.2,
+                 success_rc: tuple = (0,), gang: bool = True,
+                 log_fn=print):
+        self.spawn_fn = spawn_fn
+        self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self.poll_s = poll_s
+        self.success_rc = tuple(success_rc)
+        self.gang = gang
+        self.restarts = 0                      # gang restarts
+        self.rank_restarts = [0] * num_workers
+        self._log = log_fn
+
+    def _spawn_all(self, attempt):
+        return [self.spawn_fn(r, attempt) for r in range(self.num_workers)]
+
+    def run(self, timeout_s: float = 600.0) -> bool:
+        """Supervise until every rank succeeds (True) or the restart
+        budget / deadline is exhausted (False; survivors terminated)."""
+        import time as _time
+
+        procs = self._spawn_all(0)
+        done = [False] * self.num_workers
+        deadline = _time.monotonic() + timeout_s
+        try:
+            while not all(done):
+                if _time.monotonic() > deadline:
+                    self._log("[elastic] deadline exceeded")
+                    return False
+                failed = None
+                for r, p in enumerate(procs):
+                    if done[r] or p.poll() is None:
+                        continue
+                    rc = p.returncode
+                    if rc in self.success_rc:
+                        done[r] = True
+                    else:
+                        failed = (r, rc)
+                        break
+                if failed is None:
+                    _time.sleep(self.poll_s)
+                    continue
+                r, rc = failed
+                if self.gang:
+                    if self.restarts >= self.max_restarts:
+                        self._log(f"[elastic] rank {r} failed rc={rc}; "
+                                  "gang restart budget exhausted")
+                        return False
+                    self.restarts += 1
+                    self._log(f"[elastic] rank {r} failed rc={rc}; gang "
+                              f"restart {self.restarts}/"
+                              f"{self.max_restarts} (kill + respawn all, "
+                              "resume from checkpoint)")
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    for p in procs:
+                        p.wait()
+                    procs = self._spawn_all(self.restarts)
+                    done = [False] * self.num_workers
+                else:
+                    if self.rank_restarts[r] >= self.max_restarts:
+                        self._log(f"[elastic] rank {r} failed rc={rc}, "
+                                  "restart budget exhausted")
+                        return False
+                    self.rank_restarts[r] += 1
+                    self._log(f"[elastic] rank {r} failed rc={rc}; "
+                              f"restart {self.rank_restarts[r]}/"
+                              f"{self.max_restarts}")
+                    procs[r] = self.spawn_fn(r, self.rank_restarts[r])
+            return True
+        finally:
+            for r, p in enumerate(procs):
+                if not done[r] and p.poll() is None:
+                    p.kill()
+            for r, p in enumerate(procs):
+                if not done[r]:
+                    p.wait()  # reap: no zombies in the supervisor
+
+
+def local_shard(batch, *, index: Optional[int] = None,
+                num: Optional[int] = None):
+    """Slice a host's shard out of a global host batch (the data-feed
+    filelist-split analog at batch granularity)."""
+    import numpy as np
+
+    index = jax.process_index() if index is None else index
+    num = jax.process_count() if num is None else num
+
+    def shard(x):
+        n = x.shape[0]
+        if n % num:
+            raise ValueError(
+                f"batch dim {n} not divisible by {num} workers — pad or "
+                f"drop the remainder explicitly before sharding")
+        per = n // num
+        return x[index * per:(index + 1) * per]
+
+    return jax.tree_util.tree_map(shard, batch)

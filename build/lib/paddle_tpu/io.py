@@ -1,0 +1,136 @@
+"""Save/load: parameters, training state, inference artifacts.
+
+Reference mapping (SURVEY.md §5.4):
+- ``save_op.cc``/``load_op.cc`` + ``io.py save_persistables:496`` →
+  :func:`save_params` / :func:`load_params` (whole param pytree, one file,
+  like save_combine_op).
+- ``save_inference_model:974`` (prunes program to feed/fetch, serializes
+  ProgramDesc) → :func:`save_inference_model` (serializes StableHLO of the
+  jitted forward + params) in paddle_tpu.inference.
+- Orbax-backed async checkpointing for the distributed/large case
+  (≙ checkpoint_notify + pserver shard snapshots): :class:`CheckpointManager`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+# marker KEY for empty dict nodes: without it, a state containing an
+# empty container (e.g. SGD's opt slots {}) silently CHANGES pytree
+# structure across save/load — which then breaks jit caches / pjit
+# sharding prefixes on resume. The marker lives in the KEY namespace
+# (\x00 cannot appear in a normal field name), so no leaf VALUE can
+# collide with it.
+_EMPTY_KEY = "\x00empty"
+
+
+def _flatten(tree, prefix=()):
+    if isinstance(tree, dict):
+        if not tree:
+            return {"/".join(prefix + (_EMPTY_KEY,)): np.int8(0)}
+        out = {}
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], prefix + (str(k),)))
+        return out
+    return {"/".join(prefix): tree}
+
+
+def _unflatten(flat):
+    tree: dict = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        if parts[-1] == _EMPTY_KEY:
+            continue  # the walk above materialized the empty dict
+        node[parts[-1]] = val
+    return tree
+
+
+def save_params(params: Any, path: str):
+    """Persist a param/state pytree (save_persistables parity). Arrays are
+    pulled to host; bf16 preserved via ml_dtypes numpy arrays."""
+    flat = _flatten(jax.device_get(params))
+    flat = {k: np.asarray(v) for k, v in flat.items()}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(flat, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_params(path: str, target: Optional[Any] = None) -> Any:
+    """Load a pytree saved by save_params. With ``target``, validates that
+    shapes/keys match and preserves the target's structure ordering."""
+    with open(path, "rb") as f:
+        flat = pickle.load(f)
+    tree = _unflatten(flat)
+    if target is not None:
+        tflat = _flatten(target)
+        missing = set(tflat) - set(flat)
+        extra = set(flat) - set(tflat)
+        if missing or extra:
+            raise ValueError(
+                f"checkpoint mismatch: missing={sorted(missing)[:5]} "
+                f"extra={sorted(extra)[:5]}")
+        for k, v in tflat.items():
+            if hasattr(v, "shape") and tuple(np.shape(flat[k])) != tuple(v.shape):
+                raise ValueError(f"shape mismatch for {k}: "
+                                 f"{np.shape(flat[k])} vs {v.shape}")
+    return tree
+
+
+save_persistables = save_params
+load_persistables = load_params
+
+
+class CheckpointManager:
+    """Async, versioned, multi-host-safe checkpointing via Orbax
+    (≙ the reference's checkpoint_notify + FleetWrapper::SaveModel world)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=True)
+        self.manager = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, wait: bool = False,
+             force: bool = False):
+        """``force=True`` bypasses save_interval_steps gating — required for
+        the final end-of-fit save, which Orbax otherwise silently drops when
+        the last step is not on an interval boundary."""
+        self.manager.save(step, args=self._ocp.args.StandardSave(state),
+                          force=force)
+        if wait:
+            self.manager.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, target: Optional[Any] = None):
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            return None
+        if target is not None:
+            return self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(target))
+        return self.manager.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def wait(self):
+        self.manager.wait_until_finished()
+
+    def close(self):
+        self.manager.close()

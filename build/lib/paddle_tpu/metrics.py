@@ -1,0 +1,362 @@
+"""Streaming metrics (fluid ``metrics.py`` parity: Accuracy, Auc,
+Precision/Recall, ChunkEvaluator surface; plus ops/tensor.accuracy for the
+in-graph op). Host-side accumulators over device-computed statistics — the
+update computations are jax-traceable so they fuse into eval steps."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Streaming top-1 accuracy (fluid metrics.Accuracy)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._correct = 0.0
+        self._total = 0.0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(preds.shape[0], -1)[:, 0]
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        self._correct += float((preds == labels).sum())
+        self._total += preds.shape[0]
+        return self
+
+    def eval(self) -> float:
+        return self._correct / max(self._total, 1.0)
+
+
+class Auc(Metric):
+    """Streaming ROC-AUC via fixed binning (fluid metrics.Auc / the auc op:
+    reference accumulates a 2 x bins histogram of predicted probabilities)."""
+
+    def __init__(self, num_thresholds: int = 4095):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1)
+        self._neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, probs, labels):
+        probs = np.asarray(probs).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((probs * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._pos, idx[labels > 0.5], 1)
+        np.add.at(self._neg, idx[labels <= 0.5], 1)
+        return self
+
+    def eval(self) -> float:
+        # sweep thresholds high->low accumulating TP/FP (trapezoid rule)
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tot_p, tot_n = tp[-1], fp[-1]
+        if tot_p == 0 or tot_n == 0:
+            return 0.5
+        # prepend (0,0) so the first trapezoid from the origin is counted,
+        # matching the in-graph auc op's integration (ops/metrics_ops.py)
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class MeanMetric(Metric):
+    """Running mean of a scalar stream (loss trackers, fleet_util means)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._sum = 0.0
+        self._n = 0
+
+    def update(self, value, weight: float = 1.0):
+        self._sum += float(np.asarray(value)) * weight
+        self._n += weight
+        return self
+
+    def eval(self) -> float:
+        return self._sum / max(self._n, 1e-12)
+
+
+class ChunkEvaluator(Metric):
+    """Chunking F1 for sequence labeling (fluid metrics.ChunkEvaluator +
+    ``chunk_eval`` op). Tags follow IOB: tag = chunk_type * 2 + {0:B, 1:I},
+    with ``num_chunk_types * 2`` == outside tag ("O")."""
+
+    def __init__(self, num_chunk_types: int):
+        self.num_chunk_types = num_chunk_types
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0.0
+        self.num_label = 0.0
+        self.num_correct = 0.0
+
+    @staticmethod
+    def extract_chunks(tags, num_chunk_types):
+        """[(start, end, type), ...] from an IOB tag sequence."""
+        chunks = []
+        start = ctype = None
+        tags = list(np.asarray(tags))
+        for i, t in enumerate(tags + [num_chunk_types * 2]):
+            is_begin = t < num_chunk_types * 2 and t % 2 == 0
+            is_inside = t < num_chunk_types * 2 and t % 2 == 1
+            cur_type = t // 2 if t < num_chunk_types * 2 else None
+            if start is not None and (not is_inside or cur_type != ctype):
+                chunks.append((start, i, ctype))
+                start = ctype = None
+            if is_begin:
+                start, ctype = i, cur_type
+        return chunks
+
+    def update(self, infer_tags, label_tags, lengths=None):
+        infer_tags = np.asarray(infer_tags)
+        label_tags = np.asarray(label_tags)
+        if infer_tags.ndim == 1:
+            infer_tags = infer_tags[None]
+            label_tags = label_tags[None]
+        for i in range(infer_tags.shape[0]):
+            n = int(lengths[i]) if lengths is not None \
+                else infer_tags.shape[1]
+            inf = set(self.extract_chunks(infer_tags[i, :n],
+                                          self.num_chunk_types))
+            lab = set(self.extract_chunks(label_tags[i, :n],
+                                          self.num_chunk_types))
+            self.num_infer += len(inf)
+            self.num_label += len(lab)
+            self.num_correct += len(inf & lab)
+        return self
+
+    def eval(self):
+        p = self.num_correct / max(self.num_infer, 1e-12)
+        r = self.num_correct / max(self.num_label, 1e-12)
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"precision": p, "recall": r, "f1": f1}
+
+
+class PrecisionRecall(Metric):
+    """Binary precision/recall/F1 at a threshold (metrics.Precision/Recall)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, probs, labels):
+        probs = np.asarray(probs).reshape(-1)
+        labels = np.asarray(labels).reshape(-1) > 0.5
+        pred = probs >= self.threshold
+        self.tp += float((pred & labels).sum())
+        self.fp += float((pred & ~labels).sum())
+        self.fn += float((~pred & labels).sum())
+        return self
+
+    def eval(self):
+        p = self.tp / max(self.tp + self.fp, 1e-12)
+        r = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"precision": p, "recall": r, "f1": f1}
+
+
+def _np_box_iou(a, b):
+    """Pure-NumPy IoU (metric code must not dispatch to the device per
+    image — 5000-image evals would round-trip 5000 times)."""
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area1[:, None] + area2[None, :] - inter,
+                              1e-10)
+
+
+class EditDistance(Metric):
+    """Streaming mean edit distance (metrics.EditDistance +
+    ``edit_distance_op.cc``): Levenshtein distance between predicted and
+    reference token sequences, optionally normalized by reference length.
+    Also tracks the sequence error rate (fraction with distance > 0)."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self._dist = 0.0
+        self._wrong = 0
+        self._n = 0
+
+    @staticmethod
+    def levenshtein(a, b) -> int:
+        a = list(np.asarray(a).reshape(-1))
+        b = list(np.asarray(b).reshape(-1))
+        if not a:
+            return len(b)
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    def update(self, hyps, refs, hyp_lengths=None, ref_lengths=None):
+        for i, (h, r) in enumerate(zip(hyps, refs)):
+            h = np.asarray(h)
+            r = np.asarray(r)
+            if hyp_lengths is not None:
+                h = h[:int(hyp_lengths[i])]
+            if ref_lengths is not None:
+                r = r[:int(ref_lengths[i])]
+            d = self.levenshtein(h, r)
+            if self.normalized:
+                d = d / max(len(r), 1)
+            self._dist += d
+            self._wrong += int(d > 0)
+            self._n += 1
+        return self
+
+    def eval(self):
+        n = max(self._n, 1)
+        return {"edit_distance": self._dist / n,
+                "instance_error": self._wrong / n}
+
+
+class DetectionMAP(Metric):
+    """Mean average precision over detection outputs
+    (``operators/detection/detection_map_op.cc`` + metrics.DetectionMAP).
+    Streaming: per image feed predicted (boxes, scores, classes) with a
+    validity mask (the static-shape NMS outputs) and padded ground truths;
+    AP is computed at eval() per class, '11point' or 'integral'."""
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_version: str = "11point",
+                 evaluate_difficult: bool = False):
+        if ap_version not in ("11point", "integral"):
+            raise ValueError(f"unknown ap_version {ap_version!r}")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, tp) over all images + total gt count
+        self._records = {}
+        self._gt_count = {}
+
+    def update(self, pred_boxes, pred_scores, pred_classes, pred_valid,
+               gt_boxes, gt_classes, gt_mask, gt_difficult=None):
+        """One image. pred_* (K, ...) with bool ``pred_valid``; gt_* (G,
+        ...) with bool ``gt_mask``; ``gt_difficult`` (G,) marks boxes
+        excluded from the positive count (VOC protocol)."""
+        pv = np.asarray(pred_valid, bool)
+        pb = np.asarray(pred_boxes)[pv]
+        ps = np.asarray(pred_scores)[pv]
+        pc = np.asarray(pred_classes)[pv]
+        gm = np.asarray(gt_mask, bool)
+        gb = np.asarray(gt_boxes)[gm]
+        gc = np.asarray(gt_classes)[gm]
+        gd = (np.asarray(gt_difficult)[gm].astype(bool)
+              if gt_difficult is not None else np.zeros(len(gb), bool))
+
+        for cls in np.unique(gc):
+            n_easy = int((~gd[gc == cls]).sum()) if not \
+                self.evaluate_difficult else int((gc == cls).sum())
+            self._gt_count[int(cls)] = \
+                self._gt_count.get(int(cls), 0) + n_easy
+
+        iou = (_np_box_iou(pb.astype(np.float32), gb.astype(np.float32))
+               if len(pb) and len(gb) else np.zeros((len(pb), len(gb))))
+        order = np.argsort(-ps)
+        taken = np.zeros(len(gb), bool)
+        for i in order:
+            cls = int(pc[i])
+            rec = self._records.setdefault(cls, [])
+            same = (gc == pc[i]) & ~taken
+            cand = np.where(same)[0]
+            if len(cand) and len(pb):
+                j = cand[np.argmax(iou[i, cand])]
+                if iou[i, j] >= self.overlap_threshold:
+                    taken[j] = True
+                    if gd[j] and not self.evaluate_difficult:
+                        continue        # difficult match: drop silently
+                    rec.append((float(ps[i]), 1))
+                    continue
+            rec.append((float(ps[i]), 0))
+        return self
+
+    def _ap(self, recs, n_gt):
+        if not recs or n_gt == 0:
+            return 0.0
+        recs = sorted(recs, reverse=True)
+        tp = np.cumsum([t for _, t in recs])
+        fp = np.cumsum([1 - t for _, t in recs])
+        recall = tp / n_gt
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_version == "11point":
+            ap = 0.0
+            for r in np.linspace(0, 1, 11):
+                mask = recall >= r
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            return float(ap)
+        # integral: sum precision deltas at each recall step
+        ap = 0.0
+        prev_r = 0.0
+        for p, r in zip(precision, recall):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def eval(self) -> float:
+        # average only over classes with ground-truth instances (VOC /
+        # reference detection_map convention): a hallucinated class must
+        # not add a whole zero AP term
+        classes = [c for c, n in self._gt_count.items() if n > 0]
+        if not classes:
+            return 0.0
+        aps = [self._ap(self._records.get(c, []), self._gt_count[c])
+               for c in classes]
+        return float(np.mean(aps))
+
+
+class CompositeMetric(Metric):
+    """Bundle of metrics updated together (fluid metrics.CompositeMetric)."""
+
+    def __init__(self, *metrics):
+        self._metrics = list(metrics)
+
+    def add_metric(self, m):
+        self._metrics.append(m)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+        return self
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

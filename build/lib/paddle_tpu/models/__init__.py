@@ -1,0 +1,30 @@
+"""Model zoo covering the BASELINE.json configs: LeNet (MNIST), ResNet-50,
+BERT-base, Transformer-big, DeepFM (reference model sources:
+``python/paddle/fluid/tests/book/`` + PaddleCV/PaddleNLP recipes)."""
+
+from paddle_tpu.models.lenet import LeNet
+from paddle_tpu.models.bert import (BertConfig, BertModel, BertForPretraining)
+from paddle_tpu.models.resnet import ResNet, ResNet50
+from paddle_tpu.models.deepfm import DeepFM
+from paddle_tpu.models.transformer import Transformer, TransformerConfig
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.book import (LinearRegression, RNNLanguageModel,
+                                    RecommenderSystem, SentimentLSTM,
+                                    SkipGramNS, Word2Vec)
+from paddle_tpu.models.mobilenet import MobileNetV1, MobileNetV2
+from paddle_tpu.models.vgg import VGG, VGG16
+from paddle_tpu.models.se_resnext import SEResNeXt, SEResNeXt50
+from paddle_tpu.models.ssd import SSD, SSDConfig
+from paddle_tpu.models.faster_rcnn import FasterRCNN, FasterRCNNConfig
+from paddle_tpu.models.video import C3D, TSN
+from paddle_tpu.models.yolov3 import YOLOv3, YOLOv3Config
+from paddle_tpu.models.ocr import CRNN
+from paddle_tpu.models.gan import (DCGANDiscriminator, DCGANGenerator,
+                                   gan_step)
+
+__all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
+           "ResNet", "ResNet50", "DeepFM", "Transformer",
+           "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
+           "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
+           "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
+           "SEResNeXt50", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "C3D", "TSN", "YOLOv3", "YOLOv3Config", "CRNN", "DCGANGenerator", "DCGANDiscriminator", "gan_step"]

@@ -1,0 +1,239 @@
+"""Book-example model zoo: the reference's fluid "book" test suite parity.
+
+Reference models (``python/paddle/fluid/tests/book/``):
+- ``test_fit_a_line.py``      -> :class:`LinearRegression`
+- ``test_word2vec.py``        -> :class:`Word2Vec` (N-gram NLM variant used
+  by the book test) + skip-gram negative sampling variant
+- ``test_understand_sentiment.py`` -> :class:`SentimentLSTM` (stacked LSTM)
+- ``test_rnn_language_model`` (models repo) -> :class:`RNNLanguageModel`
+(LeNet/ResNet/BERT/Transformer/DeepFM live in their own modules.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, Linear
+from paddle_tpu.nn.module import Layer
+from paddle_tpu.nn.rnn import LSTM
+from paddle_tpu.ops import nn as ops_nn
+from paddle_tpu.ops import sequence as seq_ops
+
+
+class LinearRegression(Layer):
+    """fit_a_line: y = xW + b with MSE loss."""
+
+    def __init__(self, in_features=13):
+        super().__init__()
+        self.fc = Linear(in_features, 1, sharding=None)
+
+    def forward(self, params, x):
+        return self.fc(params["fc"], x)[:, 0]
+
+    def loss(self, params, x, y):
+        pred = self.forward(params, x)
+        return ((pred - y) ** 2).mean(), {}
+
+
+class Word2Vec(Layer):
+    """N-gram neural language model (the book's word2vec recipe: embed N
+    context words, concat, hidden layer, softmax over vocab)."""
+
+    def __init__(self, vocab_size, embed_dim=32, context=4, hidden=256):
+        super().__init__()
+        self.embed = Embedding(vocab_size, embed_dim,
+                               weight_init=I.normal(0.0, 0.02))
+        self.context = context
+        self.fc1 = Linear(context * embed_dim, hidden, sharding=None)
+        self.fc2 = Linear(hidden, vocab_size)
+
+    def forward(self, params, context_ids):
+        """context_ids: (B, context)."""
+        e = self.embed(params["embed"], context_ids)     # (B, C, D)
+        h = e.reshape(e.shape[0], -1)
+        h = jax.nn.sigmoid(self.fc1(params["fc1"], h))
+        return self.fc2(params["fc2"], h)
+
+    def loss(self, params, context_ids, target_ids):
+        logits = self.forward(params, context_ids)
+        nll = ops_nn.softmax_with_cross_entropy(
+            logits, target_ids[:, None]).mean()
+        return nll, {}
+
+
+class SkipGramNS(Layer):
+    """Skip-gram with negative sampling (the scalable word2vec)."""
+
+    def __init__(self, vocab_size, embed_dim=64):
+        super().__init__()
+        self.in_embed = Embedding(vocab_size, embed_dim,
+                                  weight_init=I.normal(0.0, 0.02))
+        self.out_embed = Embedding(vocab_size, embed_dim,
+                                   weight_init=I.zeros)
+
+    def loss(self, params, center, positive, negatives):
+        """center (B,), positive (B,), negatives (B, K)."""
+        c = self.in_embed(params["in_embed"], center)          # (B, D)
+        pos = self.out_embed(params["out_embed"], positive)    # (B, D)
+        neg = self.out_embed(params["out_embed"], negatives)   # (B, K, D)
+        pos_logit = (c * pos).sum(-1)
+        neg_logit = jnp.einsum("bd,bkd->bk", c, neg)
+        loss = (jax.nn.softplus(-pos_logit).mean()
+                + jax.nn.softplus(neg_logit).sum(-1).mean())
+        return loss, {}
+
+
+class SentimentLSTM(Layer):
+    """understand_sentiment: embedding -> stacked LSTM -> pool -> softmax."""
+
+    def __init__(self, vocab_size, num_classes=2, embed_dim=64,
+                 hidden=128, num_layers=2):
+        super().__init__()
+        self.embed = Embedding(vocab_size, embed_dim,
+                               weight_init=I.normal(0.0, 0.02))
+        self.lstm = LSTM(embed_dim, hidden, num_layers=num_layers)
+        self.fc = Linear(self.lstm.output_size, num_classes, sharding=None)
+
+    def forward(self, params, ids, lengths):
+        x = self.embed(params["embed"], ids)
+        h, _ = self.lstm(params["lstm"], x, lengths)
+        pooled = seq_ops.sequence_pool(h, lengths, "max")
+        return self.fc(params["fc"], pooled)
+
+    def loss(self, params, ids, lengths, label):
+        logits = self.forward(params, ids, lengths)
+        nll = ops_nn.softmax_with_cross_entropy(logits, label[:, None]).mean()
+        acc = (logits.argmax(-1) == label).mean()
+        return nll, {"acc": acc}
+
+
+class RNNLanguageModel(Layer):
+    """LSTM LM (PaddleNLP language_model recipe): next-token prediction
+    with tied-embedding option."""
+
+    def __init__(self, vocab_size, embed_dim=128, hidden=128, num_layers=2,
+                 tie_embeddings=True):
+        super().__init__()
+        self.embed = Embedding(vocab_size, embed_dim,
+                               weight_init=I.normal(0.0, 0.05))
+        self.lstm = LSTM(embed_dim, hidden, num_layers=num_layers)
+        self.tie = tie_embeddings and hidden == embed_dim
+        if not self.tie:
+            self.proj = Linear(hidden, vocab_size)
+
+    def forward(self, params, ids, lengths=None):
+        x = self.embed(params["embed"], ids)
+        h, _ = self.lstm(params["lstm"], x, lengths)
+        if self.tie:
+            return jnp.einsum("bsd,vd->bsv", h, params["embed"]["weight"])
+        return self.proj(params["proj"], h)
+
+    def loss(self, params, ids, targets, lengths=None):
+        logits = self.forward(params, ids, lengths)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        if lengths is not None:
+            mask = seq_ops.sequence_mask(lengths, ids.shape[1], jnp.float32)
+            denom = jnp.maximum(mask.sum(), 1.0)
+            loss = (nll * mask).sum() / denom
+            ppl = jnp.exp(loss)
+        else:
+            loss = nll.mean()
+            ppl = jnp.exp(loss)
+        return loss, {"ppl": ppl}
+
+
+class RecommenderSystem(Layer):
+    """book/05.recommender_system (test_recommender_system.py): two-tower
+    personalized-rating model — user tower (id/gender/age/occupation
+    embeddings) and movie tower (id embedding + category multi-hot),
+    fused by cosine similarity scaled to the rating range, MSE loss."""
+
+    def __init__(self, n_users=6041, n_movies=3953, n_cat=18, dim=32):
+        super().__init__()
+        self.user_emb = Embedding(n_users, dim)
+        self.gender_emb = Embedding(2, dim // 2)
+        self.age_emb = Embedding(7, dim // 2)
+        self.occ_emb = Embedding(21, dim // 2)
+        self.user_fc = Linear(dim + 3 * (dim // 2), dim, sharding=None)
+        self.movie_emb = Embedding(n_movies, dim)
+        self.cat_fc = Linear(n_cat, dim // 2, sharding=None)
+        self.movie_fc = Linear(dim + dim // 2, dim, sharding=None)
+
+    def forward(self, params, user_id, gender, age, occupation, movie_id,
+                categories):
+        u = jnp.concatenate([
+            self.user_emb(params["user_emb"], user_id),
+            self.gender_emb(params["gender_emb"], gender),
+            self.age_emb(params["age_emb"], age),
+            self.occ_emb(params["occ_emb"], occupation)], -1)
+        u = jnp.tanh(self.user_fc(params["user_fc"], u))
+        m = jnp.concatenate([
+            self.movie_emb(params["movie_emb"], movie_id),
+            jnp.tanh(self.cat_fc(params["cat_fc"], categories))], -1)
+        m = jnp.tanh(self.movie_fc(params["movie_fc"], m))
+        cos = (u * m).sum(-1) / (
+            jnp.linalg.norm(u, axis=-1) * jnp.linalg.norm(m, axis=-1)
+            + 1e-8)
+        return 5.0 * cos                      # scale_op(5) in the book
+
+    def loss(self, params, user_id, gender, age, occupation, movie_id,
+             categories, rating, *, training=True, key=None):
+        del training, key
+        pred = self.forward(params, user_id, gender, age, occupation,
+                            movie_id, categories)
+        mse = jnp.mean((pred - rating) ** 2)
+        return mse, {"mae": jnp.mean(jnp.abs(pred - rating))}
+
+
+class LabelSemanticRoles(Layer):
+    """book/07.label_semantic_roles (test_label_semantic_roles.py): SRL
+    tagger — word + predicate(+mark) embeddings -> stacked BiLSTM ->
+    per-token tag emissions -> linear-chain CRF loss, Viterbi decode.
+    The reference's 8-direction db-lstm becomes a standard deep BiLSTM;
+    the CRF comes from ``ops.crf`` (linear_chain_crf_op parity)."""
+
+    def __init__(self, vocab_size, num_tags, *, dim=32, hidden=32,
+                 depth=2):
+        super().__init__()
+        self.word_emb = Embedding(vocab_size, dim)
+        self.pred_emb = Embedding(vocab_size, dim)
+        self.mark_emb = Embedding(2, dim // 2)
+        self.lstm = LSTM(2 * dim + dim // 2, hidden, num_layers=depth,
+                         bidirectional=True)
+        self.fc = Linear(self.lstm.output_size, num_tags, sharding=None)
+        self.transition = self.create_parameter(
+            "transition", (num_tags, num_tags), initializer=I.zeros)
+        self.start = self.create_parameter("start", (num_tags,),
+                                           initializer=I.zeros)
+        self.stop = self.create_parameter("stop", (num_tags,),
+                                          initializer=I.zeros)
+
+    def emissions(self, params, words, predicate, mark, lengths):
+        x = jnp.concatenate([
+            self.word_emb(params["word_emb"], words),
+            self.pred_emb(params["pred_emb"],
+                          jnp.broadcast_to(predicate[:, None],
+                                           words.shape)),
+            self.mark_emb(params["mark_emb"], mark)], -1)
+        h, _ = self.lstm(params["lstm"], x, lengths)
+        return self.fc(params["fc"], h)
+
+    def loss(self, params, words, predicate, mark, labels, lengths, *,
+             training=True, key=None):
+        del training, key
+        from paddle_tpu.ops import crf as crf_ops
+        em = self.emissions(params, words, predicate, mark, lengths)
+        nll = crf_ops.linear_chain_crf(
+            em, labels, lengths, params["transition"],
+            start=params["start"], stop=params["stop"])
+        return nll.mean(), {}
+
+    def decode(self, params, words, predicate, mark, lengths):
+        from paddle_tpu.ops import crf as crf_ops
+        em = self.emissions(params, words, predicate, mark, lengths)
+        return crf_ops.crf_decoding(em, params["transition"], lengths,
+                                    start=params["start"],
+                                    stop=params["stop"])

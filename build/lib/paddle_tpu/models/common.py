@@ -1,0 +1,15 @@
+"""Shared model-zoo pieces (the LayerHelper-style glue every classifier
+repeats in the reference's PaddleCV zoo)."""
+
+from __future__ import annotations
+
+from paddle_tpu.ops import nn as ops_nn
+
+
+def classification_loss(logits, label):
+    """Softmax cross-entropy + top-1 accuracy — the standard image-
+    classification loss head (softmax_with_cross_entropy + accuracy op)."""
+    loss = ops_nn.softmax_with_cross_entropy(
+        logits, label[:, None]).mean()
+    acc = (logits.argmax(-1) == label).mean()
+    return loss, {"acc": acc}

@@ -1,0 +1,110 @@
+"""DeepFM CTR model — BASELINE.json config[4] (high-dim sparse embeddings).
+
+Reference recipe: Paddle CTR models run on the async CPU/PS world — sparse
+``lookup_table`` pulled from pservers/pslib (``DownpourWorker``,
+``fleet_wrapper.h:76``), dense DNN towers trained hogwild. TPU-native, two
+placements for the table (parallel/host_kv.fits_hbm decides):
+
+- :class:`DeepFM` — table fits HBM: GSPMD vocab-parallel sharding
+  (parallel/embedding.py), whole model one jitted step.
+- :class:`DeepFMHostKV` — beyond-HBM table: rows live in the host KV store
+  (parallel/host_kv.py); the jitted step takes the batch's pulled rows as a
+  differentiable input (grad w.r.t. rows = XLA scatter-add) and the host
+  applies the sparse optimizer. pslib-style combined value layout: row =
+  [w_linear, e_0..e_{D-1}] (one table, multiple value fields).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import nn as ops_nn
+
+
+class _DeepFMTowers(Layer):
+    """Shared dense half: FM second-order + DNN tower + bias over
+    already-gathered embeddings."""
+
+    def __init__(self, num_fields, embed_dim=8, hidden=(400, 400, 400)):
+        super().__init__()
+        self.num_fields = num_fields
+        self.embed_dim = embed_dim
+        layers = []
+        in_dim = num_fields * embed_dim
+        for h in hidden:
+            layers.append(Linear(in_dim, h, sharding=None,
+                                 weight_init=I.xavier_uniform()))
+            in_dim = h
+        self.dnn = LayerList(layers)
+        self.dnn_out = Linear(in_dim, 1, sharding=None)
+        self.bias = self.create_parameter("bias", (1,), initializer=I.zeros)
+
+    def forward_embedded(self, params, emb, w, feat_vals=None):
+        """emb: (B, F, D) per-feature embeddings; w: (B, F) first-order
+        weights; returns (B,) logits."""
+        b, f, _ = emb.shape
+        if feat_vals is None:
+            feat_vals = jnp.ones((b, f), jnp.float32)
+        emb = emb * feat_vals[..., None]
+        first = (w * feat_vals).sum(-1)
+        # FM second order: 0.5 * ((sum e)^2 - sum e^2)
+        s = emb.sum(axis=1)
+        second = 0.5 * (s * s - (emb * emb).sum(axis=1)).sum(-1)
+        h = emb.reshape(b, -1)
+        for i, layer in enumerate(self.dnn):
+            h = jax.nn.relu(layer(params["dnn"][str(i)], h))
+        dnn_logit = self.dnn_out(params["dnn_out"], h)[:, 0]
+        return first + second + dnn_logit + params["bias"][0]
+
+    def _loss(self, logits, label):
+        loss = ops_nn.sigmoid_cross_entropy_with_logits(
+            logits, label.astype(jnp.float32)).mean()
+        return loss, {"prob_mean": jax.nn.sigmoid(logits).mean()}
+
+
+class DeepFM(_DeepFMTowers):
+    """On-chip table variant. inputs: feat_ids (B, F) int feature ids
+    hashed into [0, vocab); optional feat_vals (B, F) float values."""
+
+    def __init__(self, vocab_size, num_fields, embed_dim=8,
+                 hidden=(400, 400, 400), axis="tp"):
+        super().__init__(num_fields, embed_dim, hidden)
+        # local import: keep the towers importable without mesh machinery
+        from paddle_tpu.parallel.embedding import ShardedEmbedding
+        self.embedding = ShardedEmbedding(vocab_size, embed_dim, axis=axis)
+        self.linear_w = ShardedEmbedding(vocab_size, 1, axis=axis)
+
+    def forward(self, params, feat_ids, feat_vals=None):
+        emb = self.embedding(params["embedding"], feat_ids)     # (B,F,D)
+        w = self.linear_w(params["linear_w"], feat_ids)[..., 0]  # (B,F)
+        return self.forward_embedded(params, emb, w, feat_vals)
+
+    def loss(self, params, feat_ids, label, feat_vals=None):
+        """label: (B,) float 0/1 click. Returns (logloss, aux)."""
+        return self._loss(self.forward(params, feat_ids, feat_vals), label)
+
+
+class DeepFMHostKV(_DeepFMTowers):
+    """Beyond-HBM variant: device params are the towers only; the sparse
+    table is a :class:`~paddle_tpu.parallel.host_kv.HostKVStore` with
+    ``dim = 1 + embed_dim`` and the step consumes its pulled rows.
+
+    row layout: ``rows[:, 0]`` first-order weight, ``rows[:, 1:]`` embedding.
+    """
+
+    kv_dim_for = staticmethod(lambda embed_dim: 1 + embed_dim)
+
+    def forward(self, params, rows, inv, feat_vals=None):
+        """rows: (U_pad, 1+D) pulled rows (differentiable input);
+        inv: (B, F) int indices into rows."""
+        gathered = jnp.take(rows, inv, axis=0)    # (B, F, 1+D)
+        w = gathered[..., 0]
+        emb = gathered[..., 1:]
+        return self.forward_embedded(params, emb, w, feat_vals)
+
+    def loss(self, params, rows, inv, label, feat_vals=None):
+        return self._loss(self.forward(params, rows, inv, feat_vals), label)

@@ -1,0 +1,215 @@
+"""Faster R-CNN (two-stage detector) — PaddleCV rcnn model family parity,
+composed end-to-end from the TPU-native detection op stack:
+anchor_generator -> rpn_target_assign -> generate_proposals ->
+generate_proposal_labels -> roi_align -> box head, all static-shape
+(validity masks carry the dynamic counts; the reference threads LoD
+tensors through the same pipeline —
+python/paddle/fluid/tests/unittests/test_generate_proposals_op.py,
+layers/detection.py rpn_target_assign/generate_proposals).
+
+TPU design notes: every stage is fixed-shape so ONE compiled program
+serves every image; proposal sampling uses the deterministic rank-capped
+subsample (pass ``key`` for the reference's randomized variant); the RoI
+head runs on exactly ``roi_batch`` sampled proposals per image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.mobilenet import MobileNetV1
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Conv2D, Linear
+from paddle_tpu.nn.module import Layer
+from paddle_tpu.ops import detection as D
+from paddle_tpu.ops import nn as ops_nn
+
+
+@dataclasses.dataclass
+class FasterRCNNConfig:
+    num_classes: int = 21                 # incl. background = 0
+    image_size: int = 224
+    backbone_scale: float = 1.0
+    anchor_sizes: Tuple[int, ...] = (32, 64, 128)
+    aspect_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    pre_nms_top_n: int = 256
+    post_nms_top_n: int = 64              # proposals kept per image
+    roi_batch: int = 32                   # sampled rois for the head
+    fg_fraction: float = 0.25
+    roi_size: int = 7
+    head_dim: int = 256
+    rpn_batch: int = 64
+
+    @classmethod
+    def tiny(cls, num_classes=4, image_size=64):
+        return cls(num_classes=num_classes, image_size=image_size,
+                   backbone_scale=0.125, anchor_sizes=(16, 32),
+                   aspect_ratios=(1.0,), pre_nms_top_n=32,
+                   post_nms_top_n=16, roi_batch=16, head_dim=32,
+                   rpn_batch=16)
+
+
+class FasterRCNN(Layer):
+    """Backbone (stride 16) -> RPN -> proposals -> RoIAlign -> box head."""
+
+    def __init__(self, cfg: FasterRCNNConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.backbone = MobileNetV1(num_classes=1,
+                                    scale=cfg.backbone_scale)
+        self._endpoint = 10               # stride-16 feature map
+        feat_ch = self.backbone.block_channels[self._endpoint]
+        a = len(cfg.anchor_sizes) * len(cfg.aspect_ratios)
+        self.num_anchors = a
+        self.rpn_conv = Conv2D(feat_ch, cfg.head_dim, 3, padding=1)
+        self.rpn_cls = Conv2D(cfg.head_dim, a, 1)
+        self.rpn_reg = Conv2D(cfg.head_dim, 4 * a, 1)
+        in_head = feat_ch * cfg.roi_size * cfg.roi_size
+        self.fc1 = Linear(in_head, cfg.head_dim, sharding=None)
+        self.fc2 = Linear(cfg.head_dim, cfg.head_dim, sharding=None)
+        self.cls_head = Linear(cfg.head_dim, cfg.num_classes,
+                               weight_init=I.normal(std=0.01), sharding=None)
+        self.reg_head = Linear(cfg.head_dim, 4 * cfg.num_classes,
+                               weight_init=I.normal(std=0.001), sharding=None)
+
+    # ---- stages ----------------------------------------------------------
+
+    def _features(self, params, image, training):
+        _, feats = self.backbone.features(
+            params["backbone"], image, training=training,
+            endpoints=(self._endpoint,))
+        return feats[self._endpoint]
+
+    def _rpn(self, params, feat):
+        h = jax.nn.relu(self.rpn_conv(params["rpn_conv"], feat))
+        scores = self.rpn_cls(params["rpn_cls"], h)      # (B, H, W, A)
+        deltas = self.rpn_reg(params["rpn_reg"], h)      # (B, H, W, 4A)
+        b, fh, fw, _ = scores.shape
+        stride = self.cfg.image_size // fh
+        anchors, _ = D.anchor_generator(
+            fh, fw, anchor_sizes=self.cfg.anchor_sizes,
+            aspect_ratios=self.cfg.aspect_ratios,
+            stride=(float(stride), float(stride)))
+        return (scores.reshape(b, -1), deltas.reshape(b, -1, 4), anchors)
+
+    def _head(self, params, feat_i, rois):
+        pooled = D.roi_align(
+            feat_i, rois,
+            output_size=(self.cfg.roi_size, self.cfg.roi_size),
+            spatial_scale=feat_i.shape[0] / self.cfg.image_size)
+        flat = pooled.reshape(rois.shape[0], -1)
+        h = jax.nn.relu(self.fc1(params["fc1"], flat))
+        h = jax.nn.relu(self.fc2(params["fc2"], h))
+        return (self.cls_head(params["cls_head"], h),
+                self.reg_head(params["reg_head"], h))
+
+    # ---- training --------------------------------------------------------
+
+    def loss(self, params, image, gt_boxes, gt_labels, gt_mask, *,
+             training=True, key=None):
+        """gt_boxes (B, G, 4) PIXEL xyxy; gt_labels (B, G) in [1, C)."""
+        cfg = self.cfg
+        feat = self._features(params, image, training)
+        scores, deltas, anchors = self._rpn(params, feat)
+        im_shape = jnp.asarray([cfg.image_size, cfg.image_size],
+                               jnp.float32)
+
+        def one(feat_i, score_i, delta_i, gt_b, gt_l, gt_m):
+            # --- RPN losses
+            labels, tgt, fg, bg = D.rpn_target_assign(
+                anchors, gt_b, gt_m, im_shape=im_shape,
+                batch_size_per_im=cfg.rpn_batch)
+            obj = ops_nn.sigmoid_cross_entropy_with_logits(
+                score_i, (labels == 1).astype(score_i.dtype))
+            used = labels >= 0
+            rpn_cls_l = (obj * used).sum() / jnp.maximum(used.sum(), 1)
+            rpn_reg_l = (ops_nn.smooth_l1(
+                delta_i, jax.lax.stop_gradient(tgt)).sum(-1)
+                * fg).sum() / jnp.maximum(fg.sum(), 1)
+
+            # --- proposals (gradients stop at sampled boxes)
+            rois, _, valid = D.generate_proposals(
+                jax.lax.stop_gradient(score_i),
+                jax.lax.stop_gradient(delta_i), anchors, im_shape,
+                pre_nms_top_n=cfg.pre_nms_top_n,
+                post_nms_top_n=cfg.post_nms_top_n, min_size=4.0)
+            rois = jax.lax.stop_gradient(rois)
+            # mix in gt boxes as guaranteed-quality proposals (reference
+            # generate_proposal_labels does the same)
+            rois = jnp.concatenate([rois, gt_b])
+            valid = jnp.concatenate([valid, gt_m])
+            roi_labels, roi_tgt, roi_fg, roi_bg = \
+                D.generate_proposal_labels(
+                    rois, valid, gt_b, gt_l, gt_m,
+                    batch_size_per_im=cfg.roi_batch,
+                    fg_fraction=cfg.fg_fraction)
+
+            # --- RoI head on a FIXED roi_batch subset
+            sampled = roi_fg | roi_bg
+            order = jnp.argsort(~sampled)         # sampled first, stable
+            pick = order[:cfg.roi_batch]
+            rois_s = rois[pick]
+            lab_s = roi_labels[pick]
+            tgt_s = roi_tgt[pick]
+            use_s = sampled[pick]
+            cls_logits, reg = self._head(params, feat_i, rois_s)
+            logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), -1)
+            ce = -jnp.take_along_axis(
+                logp, jnp.maximum(lab_s, 0)[:, None], -1)[:, 0]
+            head_cls_l = (ce * use_s).sum() / jnp.maximum(use_s.sum(), 1)
+            reg = reg.reshape(cfg.roi_batch, cfg.num_classes, 4)
+            reg_sel = jnp.take_along_axis(
+                reg, jnp.maximum(lab_s, 0)[:, None, None].repeat(4, -1),
+                1)[:, 0]
+            fg_s = use_s & (lab_s > 0)
+            head_reg_l = (ops_nn.smooth_l1(
+                reg_sel, jax.lax.stop_gradient(tgt_s)).sum(-1)
+                * fg_s).sum() / jnp.maximum(fg_s.sum(), 1)
+            return rpn_cls_l + rpn_reg_l + head_cls_l + head_reg_l
+
+        losses = jax.vmap(one)(feat, scores, deltas, gt_boxes, gt_labels,
+                               gt_mask)
+        return losses.mean(), {}
+
+    # ---- inference -------------------------------------------------------
+
+    def detect(self, params, image, *, score_threshold=0.05,
+               nms_threshold=0.5, max_per_class=10):
+        cfg = self.cfg
+        feat = self._features(params, image, training=False)
+        scores, deltas, anchors = self._rpn(params, feat)
+        im_shape = jnp.asarray([cfg.image_size, cfg.image_size],
+                               jnp.float32)
+
+        def one(feat_i, score_i, delta_i):
+            rois, _, valid = D.generate_proposals(
+                score_i, delta_i, anchors, im_shape,
+                pre_nms_top_n=cfg.pre_nms_top_n,
+                post_nms_top_n=cfg.post_nms_top_n, min_size=4.0)
+            cls_logits, reg = self._head(params, feat_i, rois)
+            probs = jax.nn.softmax(cls_logits.astype(jnp.float32), -1)
+            probs = probs * valid[:, None]
+            reg = reg.reshape(rois.shape[0], cfg.num_classes, 4)
+            # decode per-class boxes; class 0 = background dropped.
+            # Per-class NMS (multiclass_nms) — one flat NMS would let
+            # overlapping objects of DIFFERENT classes suppress each other
+            boxes_c = jax.vmap(
+                lambda dc: D.box_clip(D.box_decode(dc, rois), im_shape),
+                in_axes=1, out_axes=1)(reg)       # (R, C, 4)
+            # multiclass_nms shares one box set across classes: use the
+            # per-roi best-foreground-class decoded box as that set
+            best_c = jnp.argmax(probs[:, 1:], axis=-1) + 1
+            cand = jnp.take_along_axis(
+                boxes_c, best_c[:, None, None].repeat(4, -1), 1)[:, 0]
+            cls_ids, idxs, ok = D.multiclass_nms(
+                cand, probs[:, 1:], iou_threshold=nms_threshold,
+                score_threshold=score_threshold,
+                max_per_class=max_per_class)
+            sel = jnp.where(ok, probs[idxs, cls_ids + 1], 0.0)
+            return cand[idxs], cls_ids + 1, sel, ok
+
+        return jax.vmap(one)(feat, scores, deltas)

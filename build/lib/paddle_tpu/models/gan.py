@@ -1,0 +1,148 @@
+"""DCGAN — book/09.image_generation parity (test_image_generation* /
+fluid GAN examples): transposed-conv generator + conv discriminator with
+alternating adversarial updates. TPU-native: both networks are pytree
+models; ``gan_step`` runs one D step + one G step as two jitted fused
+updates (the reference alternates two programs over shared scopes)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear
+from paddle_tpu.nn.module import (Layer, LayerList, apply_state_updates,
+                                  capture_state)
+from paddle_tpu.ops import nn as ops_nn
+
+
+class DCGANGenerator(Layer):
+    """z (B, zdim) -> (B, s, s, out_ch) in [-1, 1]; s = 4 * 2^n_up."""
+
+    def __init__(self, zdim=64, base=32, n_up=3, out_ch=1):
+        super().__init__()
+        self.base0 = base * (2 ** (n_up - 1))
+        self.fc = Linear(zdim, 4 * 4 * self.base0, sharding=None)
+        bns = []
+        ch = self.base0
+        for i in range(n_up):
+            out = out_ch if i == n_up - 1 else ch // 2
+            self.create_parameter(f"up{i}", (4, 4, ch, out),
+                                  initializer=I.normal(std=0.02))
+            if i != n_up - 1:
+                bns.append(BatchNorm(out))
+            ch = out
+        self._n_up = n_up
+        self.bns = LayerList(bns)
+
+    def forward(self, params, z, training=False):
+        x = self.fc(params["fc"], z).reshape(-1, 4, 4, self.base0)
+        x = jax.nn.relu(x)
+        for i in range(self._n_up):
+            w = params[f"up{i}"]
+            x = ops_nn.conv2d_transpose(x, w, stride=2, padding=1)
+            if i != self._n_up - 1:
+                x = self.bns[i](params["bns"][str(i)], x,
+                                training=training)
+                x = jax.nn.relu(x)
+        return jnp.tanh(x)
+
+
+class DCGANDiscriminator(Layer):
+    """Input must be (4 * 2^n_down) square — the mirror of the
+    generator's s = 4 * 2^n_up output (asserted in forward)."""
+
+    def __init__(self, in_ch=1, base=32, n_down=3):
+        super().__init__()
+        self._in_size = 4 * (2 ** n_down)
+        convs, bns = [], []
+        ch_in = in_ch
+        ch = base
+        for i in range(n_down):
+            # bias only on the first conv: the following BatchNorm's
+            # mean-subtraction cancels any bias (ConvBNLayer convention)
+            convs.append(Conv2D(ch_in, ch, 4, stride=2, padding=1,
+                                bias=(i == 0),
+                                weight_init=I.normal(std=0.02)))
+            if i > 0:
+                bns.append(BatchNorm(ch))
+            ch_in = ch
+            ch *= 2
+        self.convs = LayerList(convs)
+        self.bns = LayerList(bns)
+        self.fc = Linear(ch_in * 4 * 4, 1, sharding=None)
+
+    def forward(self, params, x, training=False):
+        if x.shape[1] != self._in_size or x.shape[2] != self._in_size:
+            raise ValueError(
+                f"discriminator expects {self._in_size}x{self._in_size} "
+                f"inputs (4 * 2^n_down), got {x.shape[1]}x{x.shape[2]}")
+        for i, conv in enumerate(self.convs):
+            x = conv(params["convs"][str(i)], x)
+            if i > 0:
+                x = self.bns[i - 1](params["bns"][str(i - 1)], x,
+                                    training=training)
+            x = jax.nn.leaky_relu(x, 0.2)
+        return self.fc(params["fc"], x.reshape(x.shape[0], -1))[:, 0]
+
+
+def gan_step(gen, disc, g_opt, d_opt):
+    """Returns jittable ``step(g_state, d_state, real, key) ->
+    (g_state, d_state, metrics)`` doing one discriminator update (real
+    vs fake, non-saturating BCE) then one generator update."""
+
+    # BN running stats ride the state tape exactly like build_train_step:
+    # each loss returns (loss, tape-updates) and the updated params get
+    # the new stats merged back — inference-mode forwards then normalize
+    # with genuinely trained statistics
+
+    # tape scoping: paths are model-relative, so gen and disc tapes MUST
+    # be captured separately (their "bns/0/mean" keys collide); each
+    # model's stats update only on ITS optimization step
+
+    def d_loss(d_params, g_params, real, z):
+        with capture_state():                 # throwaway: gen stats
+            fake = gen(g_params, z, training=True)
+        # the REAL batch carries the stats (a shared tape would let the
+        # fake forward overwrite them path-by-path — inference-mode BN
+        # must track real-data statistics); fake stats are discarded
+        with capture_state() as tape:
+            r = disc(d_params, real, training=True)
+        with capture_state():
+            f = disc(d_params, jax.lax.stop_gradient(fake),
+                     training=True)
+        bce = ops_nn.sigmoid_cross_entropy_with_logits
+        loss = (bce(r, jnp.ones_like(r)).mean()
+                + bce(f, jnp.zeros_like(f)).mean())
+        return loss, dict(tape.updates)
+
+    def g_loss(g_params, d_params, z):
+        with capture_state() as tape:
+            fake = gen(g_params, z, training=True)
+        with capture_state():                 # throwaway: disc stats
+            f = disc(d_params, fake, training=True)
+        loss = ops_nn.sigmoid_cross_entropy_with_logits(
+            f, jnp.ones_like(f)).mean()
+        return loss, dict(tape.updates)
+
+    def step(g_state, d_state, real, key):
+        zdim = g_state["params"]["fc"]["weight"].shape[0]
+        z1, z2 = jax.random.split(key)
+        z = jax.random.normal(z1, (real.shape[0], zdim))
+        (dl, d_tape), d_grads = jax.value_and_grad(d_loss, has_aux=True)(
+            d_state["params"], g_state["params"], real, z)
+        d_new, d_opt_state = d_opt.update(d_grads, d_state["opt"],
+                                          d_state["params"])
+        d_new = apply_state_updates(d_new, d_tape)
+        d_state = dict(d_state, params=d_new, opt=d_opt_state)
+
+        z = jax.random.normal(z2, (real.shape[0], zdim))
+        (gl, g_tape), g_grads = jax.value_and_grad(g_loss, has_aux=True)(
+            g_state["params"], d_state["params"], z)
+        g_new, g_opt_state = g_opt.update(g_grads, g_state["opt"],
+                                          g_state["params"])
+        g_new = apply_state_updates(g_new, g_tape)
+        g_state = dict(g_state, params=g_new, opt=g_opt_state)
+        return g_state, d_state, {"d_loss": dl, "g_loss": gl}
+
+    return step

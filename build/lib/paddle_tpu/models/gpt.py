@@ -1,0 +1,178 @@
+"""Decoder-only causal language model (GPT-style).
+
+Beyond-reference capability (the reference era predates GPT training
+recipes), included because the decoder stack, flash causal attention, and
+sp/tp shardings make it free — and it is the canonical long-context
+workload for ring attention. Pre-LN, learned positions, tied head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm
+from paddle_tpu.nn.module import Layer, LayerList, StackedLayers
+from paddle_tpu.nn.transformer import (ACT_SPEC, FeedForward,
+                                       MultiHeadAttention, _constrain)
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 3072
+    max_position: int = 1024
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+    # GPipe the block stack over the "pp" mesh axis (parallel/pipeline.py)
+    pipeline: bool = False
+    pp_microbatches: int = 2
+    pp_schedule: str = "gpipe"    # or "circular" (interleaved 1F1B)
+    pp_circuits: int = 1
+    pp_pre_interleaved: bool = False  # params pre-converted w/
+    #   parallel.pipeline.interleave_stack (skips per-step reshuffle)
+    # stacked (L, ...) scan-over-layers param layout (see BertConfig);
+    # defaults on with pipeline. NOTE: changes the checkpoint tree —
+    # migrate older per-layer trees with
+    # parallel.pipeline.stack_params_at(params, ("blocks",), L).
+    stacked_layers: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.stacked_layers is None:
+            self.stacked_layers = self.pipeline
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("ffn_size", 64)
+        kw.setdefault("max_position", 64)
+        return cls(**kw)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.attn = MultiHeadAttention(cfg.hidden_size, cfg.num_heads,
+                                       dropout=cfg.dropout, causal=True,
+                                       attn_impl=cfg.attn_impl)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.mlp = FeedForward(cfg.hidden_size, cfg.ffn_size,
+                               activation="gelu", dropout=cfg.dropout)
+
+    def forward(self, params, x, *, key=None, training=False):
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        x = x + self.attn(params["attn"], self.ln1(params["ln1"], x),
+                          key=k1, training=training)
+        x = x + self.mlp(params["mlp"], self.ln2(params["ln2"], x),
+                         key=k2, training=training)
+        return x
+
+
+class GPT(Layer):
+    """Causal LM: forward returns logits; loss is shifted next-token NLL."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                             weight_init=I.normal(0.0, 0.02))
+        self.wpe = Embedding(cfg.max_position, cfg.hidden_size,
+                             weight_init=I.normal(0.0, 0.01), sharding=None)
+        self.drop = Dropout(cfg.dropout)
+        if cfg.stacked_layers:
+            self.blocks = StackedLayers(GPTBlock(cfg), cfg.num_layers)
+        else:
+            self.blocks = LayerList([GPTBlock(cfg)
+                                     for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, params, ids, *, key=None, training=False):
+        cfg = self.cfg
+        keys = [None] * (cfg.num_layers + 1)
+        if key is not None:
+            keys = list(jax.random.split(key, cfg.num_layers + 1))
+        pos = jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
+        x = self.wte(params["wte"], ids) + self.wpe(params["wpe"], pos)
+        x = self.drop(None, x, key=keys[0], training=training)
+        x = _constrain(x, ACT_SPEC)
+        if cfg.pipeline:
+            x = self._blocks_pipelined(params, x, keys[1:], training)
+        elif cfg.stacked_layers:
+            lkeys = (jnp.stack(keys[1:]) if keys[1] is not None else None)
+            x = self.blocks(params["blocks"], x, layer_keys=lkeys,
+                            training=training)
+        else:
+            for i, block in enumerate(self.blocks):
+                x = block(params["blocks"][str(i)], x, key=keys[i + 1],
+                          training=training)
+        x = self.ln_f(params["ln_f"], x)
+        return jnp.einsum("bsd,vd->bsv", x, params["wte"]["weight"])
+
+    def _blocks_pipelined(self, params, x, layer_keys, training):
+        """GPipe over "pp" (shared schedule wrapper; the decoder-only
+        stack has no per-microbatch bias — causality is inside the
+        block)."""
+        from paddle_tpu.parallel import pipeline as pp_lib
+
+        cfg = self.cfg
+        if cfg.stacked_layers:
+            block0 = self.blocks.template
+            blk_params = params["blocks"]        # pre-stacked (L, ...)
+        else:
+            block0 = self.blocks[0]
+            blk_params = [params["blocks"][str(i)]
+                          for i in range(cfg.num_layers)]
+        return pp_lib.gpipe_layer_stack(
+            lambda lp, h, extra, k: block0(lp, h, key=k,
+                                           training=training),
+            blk_params, x, num_microbatches=cfg.pp_microbatches,
+            layer_keys=layer_keys, schedule=cfg.pp_schedule,
+            num_circuits=cfg.pp_circuits,
+            pre_interleaved=cfg.pp_pre_interleaved)
+
+    def loss(self, params, ids, *, key=None, training=True):
+        """Next-token LM loss over ids (B, S): predict ids[:,1:]."""
+        logits = self.forward(params, ids[:, :-1], key=key,
+                              training=training)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, ids[:, 1:, None], -1)[..., 0]
+        loss = nll.mean()
+        return loss, {"ppl": jnp.exp(loss)}
+
+    def generate(self, params, prompt_ids, max_new_tokens=32,
+                 temperature=1.0, key=None):
+        """Autoregressive sampling (greedy when key is None). Static-shape
+        loop; prompt_ids (B, S0) with S0+max_new <= max_position."""
+        b, s0 = prompt_ids.shape
+        total = s0 + max_new_tokens
+        ids = jnp.concatenate(
+            [prompt_ids,
+             jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
+
+        def body(t, carry):
+            ids, key = carry
+            logits = self.forward(params, ids)[:, t - 1]
+            if key is None:
+                nxt = logits.argmax(-1).astype(jnp.int32)
+                new_key = None
+            else:
+                key, new_key = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    key, logits / temperature).astype(jnp.int32)
+            return ids.at[:, t].set(nxt), new_key
+
+        ids, _ = jax.lax.fori_loop(s0, total, body, (ids, key))
+        return ids

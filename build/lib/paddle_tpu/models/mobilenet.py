@@ -1,0 +1,138 @@
+"""MobileNet V1/V2 — PaddleCV image_classification zoo parity
+(reference models built on fluid conv2d with ``groups=`` depthwise convs,
+``layers/nn.py:2417``). TPU-native: NHWC end-to-end, depthwise stages kept
+as grouped convs XLA lowers to efficient TPU convolutions, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.models.common import classification_loss
+from paddle_tpu.models.resnet import ConvBNLayer
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer, LayerList
+
+
+class _DepthwiseSeparable(Layer):
+    """MobileNetV1 block: 3x3 depthwise + 1x1 pointwise."""
+
+    def __init__(self, in_ch, out_ch, stride=1):
+        super().__init__()
+        self.dw = ConvBNLayer(in_ch, in_ch, 3, stride=stride,
+                              groups=in_ch, act="relu")
+        self.pw = ConvBNLayer(in_ch, out_ch, 1, act="relu")
+
+    def forward(self, params, x, training=False):
+        return self.pw(params["pw"], self.dw(params["dw"], x,
+                                             training=training),
+                       training=training)
+
+
+class MobileNetV1(Layer):
+    """MobileNetV1 (PaddleCV mobilenet.py). ``scale`` = width multiplier.
+    ``features`` exposes intermediate endpoints (for SSD heads)."""
+
+    CFG = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1)]
+
+    def __init__(self, num_classes=1000, scale=1.0, in_ch=3):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        self.stem = ConvBNLayer(in_ch, c(32), 3, stride=2, act="relu")
+        blocks = []
+        prev = c(32)
+        self.block_channels = []   # per-block output widths (for heads)
+        for out, stride in self.CFG:
+            blocks.append(_DepthwiseSeparable(prev, c(out), stride))
+            prev = c(out)
+            self.block_channels.append(prev)
+        self.blocks = LayerList(blocks)
+        self.out_ch = prev
+        self.fc = Linear(prev, num_classes,
+                         weight_init=I.msra_uniform(fan_in=prev),
+                         sharding=None)
+
+    def features(self, params, x, training=False, *, endpoints=()):
+        """Forward through the conv trunk; returns (final, {idx: feat})."""
+        x = self.stem(params["stem"], x, training=training)
+        feats = {}
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+            if i in endpoints:
+                feats[i] = x
+        return x, feats
+
+    def forward(self, params, x, training=False):
+        x, _ = self.features(params, x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True):
+        return classification_loss(
+            self.forward(params, image, training=training), label)
+
+
+class _InvertedResidual(Layer):
+    """MobileNetV2 block: 1x1 expand -> 3x3 depthwise -> 1x1 project."""
+
+    def __init__(self, in_ch, out_ch, stride, expand):
+        super().__init__()
+        mid = in_ch * expand
+        self.has_expand = expand != 1
+        if self.has_expand:
+            self.expand = ConvBNLayer(in_ch, mid, 1, act="relu6")
+        self.dw = ConvBNLayer(mid, mid, 3, stride=stride, groups=mid,
+                              act="relu6")
+        self.project = ConvBNLayer(mid, out_ch, 1)
+        self.residual = stride == 1 and in_ch == out_ch
+
+    def forward(self, params, x, training=False):
+        y = self.expand(params["expand"], x, training=training) \
+            if self.has_expand else x
+        y = self.dw(params["dw"], y, training=training)
+        y = self.project(params["project"], y, training=training)
+        return x + y if self.residual else y
+
+
+class MobileNetV2(Layer):
+    """MobileNetV2 (PaddleCV mobilenet_v2.py)."""
+
+    CFG = [  # expand, out, repeats, stride
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+    def __init__(self, num_classes=1000, scale=1.0, in_ch=3):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        self.stem = ConvBNLayer(in_ch, c(32), 3, stride=2, act="relu6")
+        blocks = []
+        prev = c(32)
+        for expand, out, reps, stride in self.CFG:
+            for i in range(reps):
+                blocks.append(_InvertedResidual(
+                    prev, c(out), stride if i == 0 else 1, expand))
+                prev = c(out)
+        self.blocks = LayerList(blocks)
+        last = max(1280, int(1280 * scale))
+        self.head = ConvBNLayer(prev, last, 1, act="relu6")
+        self.out_ch = last
+        self.fc = Linear(last, num_classes,
+                         weight_init=I.msra_uniform(fan_in=last),
+                         sharding=None)
+
+    def forward(self, params, x, training=False):
+        x = self.stem(params["stem"], x, training=training)
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+        x = self.head(params["head"], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True):
+        return classification_loss(
+            self.forward(params, image, training=training), label)

@@ -1,0 +1,70 @@
+"""OCR recognition — PaddleCV ocr_recognition (CRNN-CTC) parity: conv
+feature extractor -> columns-as-timesteps -> bidirectional recurrent
+encoder -> per-frame vocab logits -> CTC loss, greedy-decoded and scored
+with edit distance. The reference composes conv + im2sequence +
+dynamic_gru + warpctc (fluid layers); here the same op stack from
+``ops.crf``/``ops.nn`` with static shapes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.resnet import ConvBNLayer
+from paddle_tpu.nn.layers import Linear
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.nn.rnn import BiRNN, GRUCell
+from paddle_tpu.ops import crf as crf_ops
+
+
+class CRNN(Layer):
+    """``x``: (B, H, W, C) text-line images; width becomes time. Vocab
+    index 0 is the CTC blank (warpctc convention)."""
+
+    def __init__(self, vocab_size, *, in_ch=1, width=32, hidden=48,
+                 img_h=32):
+        super().__init__()
+        # conv trunk: height collapses by pooling, width is preserved
+        # beyond /4 so it can carry the sequence
+        self.convs = LayerList([
+            ConvBNLayer(in_ch, width, 3, act="relu"),
+            ConvBNLayer(width, width * 2, 3, act="relu"),
+            ConvBNLayer(width * 2, width * 2, 3, act="relu"),
+        ])
+        self._pools = [(2, 2), (2, 2), (2, 1)]   # h/8, w/4
+        feat_h = img_h // 8
+        feat_dim = width * 2 * feat_h
+        self.rnn = BiRNN(GRUCell(feat_dim, hidden),
+                         GRUCell(feat_dim, hidden))
+        self.head = Linear(2 * hidden, vocab_size, sharding=None)
+
+    def logits(self, params, x, training=False):
+        """-> (B, T, V) per-column logits, T = W // 4."""
+        from paddle_tpu.ops import nn as ops_nn
+        for i, conv in enumerate(self.convs):
+            x = conv(params["convs"][str(i)], x, training=training)
+            ph, pw = self._pools[i]
+            x = ops_nn.pool2d(x, kernel=(ph, pw), stride=(ph, pw),
+                              pool_type="max")
+        b, h, w, c = x.shape
+        seq = x.transpose(0, 2, 1, 3).reshape(b, w, h * c)  # cols = time
+        enc, _ = self.rnn(params["rnn"], seq)
+        return self.head(params["head"], enc)
+
+    def loss(self, params, image, label, label_lengths, *,
+             training=True, key=None):
+        del key
+        logits = self.logits(params, image, training=training)
+        t = logits.shape[1]
+        nll = crf_ops.ctc_loss(
+            logits, jnp.full((image.shape[0],), t), label,
+            label_lengths)
+        return nll.mean(), {}
+
+    def recognize(self, params, image):
+        """Greedy CTC decode -> (tokens (B, T), lengths (B,))."""
+        logits = self.logits(params, image, training=False)
+        probs = jax.nn.softmax(logits, -1)
+        t = logits.shape[1]
+        return crf_ops.ctc_greedy_decoder(
+            probs, jnp.full((image.shape[0],), t))

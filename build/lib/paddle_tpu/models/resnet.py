@@ -1,0 +1,207 @@
+"""ResNet family (ResNet-18/34/50/101/152) — BASELINE.json config[1].
+
+Reference model: PaddleCV image_classification ResNet-50 (built on fluid
+``layers/nn.py`` conv2d:2417 + batch_norm:3871). TPU-native design: NHWC
+layout end-to-end (the TPU conv layout; the reference uses NCHW for cuDNN),
+BatchNorm running stats through the functional state tape, bf16-friendly
+(all convs feed the MXU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear, Pool2D
+from paddle_tpu.nn.module import Layer, LayerList
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, groups=1,
+                 act=None):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias=False)
+        self.bn = BatchNorm(out_ch)
+        self.act = act
+
+    def forward(self, params, x, training=False):
+        x = self.conv(params["conv"], x)
+        x = self.bn(params["bn"], x, training=training)
+        if self.act == "relu":
+            x = jax.nn.relu(x)
+        elif self.act == "relu6":
+            x = jnp.clip(x, 0.0, 6.0)
+        return x
+
+
+def space_to_depth(x, block=2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C); channel order (r, s, c)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c)
+
+
+class S2DStemConv(Layer):
+    """MXU-friendly ResNet stem: the 7x7/stride-2 conv on 3 channels is
+    mathematically re-expressed as a 4x4/stride-1 conv on the 2x2
+    space-to-depth-blocked 12-channel input (the MLPerf-style transform —
+    identical function, 4x the contraction channels, no strided gather).
+    Weights are STORED blocked (4, 4, 4*in_ch, out); use
+    :func:`stem_weights_to_s2d` to convert a trained 7x7 checkpoint."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        # fan_in of the equivalent 7x7 conv (49 taps, not 16*4): keeps the
+        # init distribution of the standard stem
+        self.weight = self.create_parameter(
+            "weight", (4, 4, 4 * in_ch, out_ch),
+            initializer=I.msra_normal(fan_in=in_ch * 49))
+
+    def forward(self, params, x):
+        xb = space_to_depth(x, 2)
+        return jax.lax.conv_general_dilated(
+            xb, params["weight"].astype(xb.dtype), (1, 1),
+            ((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def stem_weights_to_s2d(w7):
+    """(7, 7, C, O) standard stem weights -> (4, 4, 4C, O) blocked weights
+    computing the identical function (pixel (2a+r, 2b+s) lives in blocked
+    channel slot (2r+s)*C + c; kernel tap i = 2*ka + r - 1)."""
+    k, k2, c, o = w7.shape
+    if (k, k2) != (7, 7):
+        raise ValueError(f"expected 7x7 stem weights, got {w7.shape}")
+    wb = jnp.zeros((4, 4, 4 * c, o), w7.dtype)
+    for ka in range(4):
+        for r in range(2):
+            i = 2 * ka + r - 1
+            if not 0 <= i <= 6:
+                continue
+            for kb in range(4):
+                for s in range(2):
+                    j = 2 * kb + s - 1
+                    if not 0 <= j <= 6:
+                        continue
+                    sl = (r * 2 + s) * c
+                    wb = wb.at[ka, kb, sl:sl + c, :].set(w7[i, j])
+    return wb
+
+
+class S2DStem(Layer):
+    """ConvBNLayer-shaped wrapper so the param tree keeps the
+    stem/{conv,bn} structure (checkpoint layout parity with the 7x7 stem:
+    only the conv weight shape differs)."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.conv = S2DStemConv(in_ch, out_ch)
+        self.bn = BatchNorm(out_ch)
+
+    def forward(self, params, x, training=False):
+        x = self.conv(params["conv"], x)
+        x = self.bn(params["bn"], x, training=training)
+        return jax.nn.relu(x)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=False):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride, act="relu")
+        self.conv2 = ConvBNLayer(ch, ch * 4, 1)
+        self.has_short = downsample
+        if downsample:
+            self.short = ConvBNLayer(in_ch, ch * 4, 1, stride=stride)
+
+    def forward(self, params, x, training=False):
+        y = self.conv0(params["conv0"], x, training=training)
+        y = self.conv1(params["conv1"], y, training=training)
+        y = self.conv2(params["conv2"], y, training=training)
+        s = self.short(params["short"], x, training=training) \
+            if self.has_short else x
+        return jax.nn.relu(y + s)
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=False):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 3, stride=stride, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3)
+        self.has_short = downsample
+        if downsample:
+            self.short = ConvBNLayer(in_ch, ch, 1, stride=stride)
+
+    def forward(self, params, x, training=False):
+        y = self.conv0(params["conv0"], x, training=training)
+        y = self.conv1(params["conv1"], y, training=training)
+        s = self.short(params["short"], x, training=training) \
+            if self.has_short else x
+        return jax.nn.relu(y + s)
+
+
+_DEPTHS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+}
+
+
+class ResNet(Layer):
+    """NHWC ResNet. ``width`` scales channel counts (width=64 standard;
+    tests use small widths)."""
+
+    def __init__(self, depth=50, num_classes=1000, width=64, in_ch=3,
+                 stem="conv7"):
+        super().__init__()
+        if depth not in _DEPTHS:
+            raise ValueError(f"depth must be one of {sorted(_DEPTHS)}")
+        if stem not in ("conv7", "s2d"):
+            raise ValueError(f"stem must be 'conv7' or 's2d', got {stem!r}")
+        block_cls, counts = _DEPTHS[depth]
+        self.stem = (S2DStem(in_ch, width) if stem == "s2d" else
+                     ConvBNLayer(in_ch, width, 7, stride=2, act="relu"))
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        blocks = []
+        ch_in = width
+        for stage, n in enumerate(counts):
+            ch = width * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                downsample = (i == 0 and
+                              (stride != 1 or ch_in != ch * block_cls.expansion))
+                blocks.append(block_cls(ch_in, ch, stride=stride,
+                                        downsample=downsample))
+                ch_in = ch * block_cls.expansion
+        self.blocks = LayerList(blocks)
+        self.fc = Linear(ch_in, num_classes,
+                         weight_init=I.msra_uniform(fan_in=ch_in),
+                         sharding=None)
+
+    def forward(self, params, x, training=False):
+        """x: (B, H, W, C) NHWC images -> (B, num_classes) logits."""
+        x = self.stem(params["stem"], x, training=training)
+        x = self.pool(None, x)
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training), label)
+
+
+def ResNet50(num_classes=1000, **kw):
+    return ResNet(50, num_classes=num_classes, **kw)

@@ -1,0 +1,102 @@
+"""SE-ResNeXt-50/101/152 — the reference's flagship distributed-test model
+(``python/paddle/fluid/tests/unittests/dist_se_resnext.py``, PaddleCV
+se_resnext.py): ResNeXt grouped-conv bottlenecks + squeeze-excitation
+channel gating. NHWC/TPU-first like models/resnet.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Linear, Pool2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.models.resnet import ConvBNLayer
+
+
+class SEBlock(Layer):
+    """Squeeze-and-excitation: GAP -> fc/ratio -> relu -> fc -> sigmoid."""
+
+    def __init__(self, ch, ratio=16):
+        super().__init__()
+        mid = max(ch // ratio, 4)
+        self.down = Linear(ch, mid, sharding=None)
+        self.up = Linear(mid, ch, sharding=None)
+
+    def forward(self, params, x):
+        s = jnp.mean(x, axis=(1, 2))                      # (B, C)
+        s = jax.nn.relu(self.down(params["down"], s))
+        s = jax.nn.sigmoid(self.up(params["up"], s))
+        return x * s[:, None, None, :]
+
+
+class SEBottleneck(Layer):
+    def __init__(self, in_ch, ch, stride=1, cardinality=32, ratio=16,
+                 downsample=False):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride,
+                                 groups=cardinality, act="relu")
+        self.conv2 = ConvBNLayer(ch, ch * 2, 1)
+        self.se = SEBlock(ch * 2, ratio=ratio)
+        self.has_short = downsample
+        if downsample:
+            self.short = ConvBNLayer(in_ch, ch * 2, 1, stride=stride)
+
+    def forward(self, params, x, training=False):
+        y = self.conv0(params["conv0"], x, training=training)
+        y = self.conv1(params["conv1"], y, training=training)
+        y = self.conv2(params["conv2"], y, training=training)
+        y = self.se(params["se"], y)
+        s = self.short(params["short"], x, training=training) \
+            if self.has_short else x
+        return jax.nn.relu(y + s)
+
+
+_DEPTHS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+class SEResNeXt(Layer):
+    """``width`` scales channels (standard 128 for the 32x4d trunk);
+    ``cardinality`` = group count. Tests use small width/cardinality."""
+
+    def __init__(self, depth=50, num_classes=1000, width=128,
+                 cardinality=32, ratio=16, in_ch=3):
+        super().__init__()
+        if depth not in _DEPTHS:
+            raise ValueError(f"depth must be one of {sorted(_DEPTHS)}")
+        stem_ch = width // 2
+        self.stem = ConvBNLayer(in_ch, stem_ch, 7, stride=2, act="relu")
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        blocks = []
+        ch_in = stem_ch
+        for stage, n in enumerate(_DEPTHS[depth]):
+            ch = width * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                downsample = i == 0 and (stride != 1 or ch_in != ch * 2)
+                blocks.append(SEBottleneck(
+                    ch_in, ch, stride=stride, cardinality=cardinality,
+                    ratio=ratio, downsample=downsample))
+                ch_in = ch * 2
+        self.blocks = LayerList(blocks)
+        self.fc = Linear(ch_in, num_classes,
+                         weight_init=I.msra_uniform(fan_in=ch_in),
+                         sharding=None)
+
+    def forward(self, params, x, training=False):
+        x = self.stem(params["stem"], x, training=training)
+        x = self.pool(None, x)
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training), label)
+
+
+def SEResNeXt50(num_classes=1000, **kw):
+    return SEResNeXt(50, num_classes=num_classes, **kw)

@@ -1,0 +1,155 @@
+"""SSD detector (MobileNetV1-SSD) — PaddleCV object_detection parity: the
+reference composes ``fluid.layers.multi_box_head`` + ``ssd_loss`` +
+``detection_output`` (python/paddle/fluid/layers/detection.py) over a
+MobileNet backbone. TPU-native: NHWC trunk, anchors precomputed as static
+arrays at build time, loss/decode from ``ops.detection`` (static shapes,
+validity-masked NMS)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.mobilenet import MobileNetV1
+from paddle_tpu.models.resnet import ConvBNLayer
+from paddle_tpu.nn.layers import Conv2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import detection as D
+
+
+@dataclasses.dataclass
+class SSDConfig:
+    num_classes: int = 21            # including background = 0
+    image_size: int = 300
+    backbone_scale: float = 1.0
+    # backbone endpoints: (block_index or -1 for final) per feature level
+    endpoints: Tuple[int, ...] = (10, -1)
+    # extra stride-2 feature layers appended after the backbone
+    extra_channels: Tuple[int, ...] = (512, 256)
+    min_ratio: float = 0.2
+    max_ratio: float = 0.95
+    aspect_ratios: Tuple[float, ...] = (1.0, 2.0, 0.5)
+    variances: Tuple[float, ...] = (0.1, 0.1, 0.2, 0.2)
+
+    @classmethod
+    def tiny(cls, num_classes=4, image_size=64):
+        """Small config for tests/CI: 32-ch backbone, 2 extra levels."""
+        return cls(num_classes=num_classes, image_size=image_size,
+                   backbone_scale=0.125, endpoints=(5, -1),
+                   extra_channels=(32,))
+
+
+class SSD(Layer):
+    """MobileNetV1-SSD. ``forward`` returns (loc (B, P, 4) deltas, conf
+    (B, P, C) logits); ``loss`` is the multibox SSD loss; ``detect``
+    decodes + per-class NMS."""
+
+    def __init__(self, cfg: SSDConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.backbone = MobileNetV1(num_classes=1,
+                                    scale=cfg.backbone_scale)
+        self._endpoints = tuple(
+            i if i >= 0 else len(self.backbone.blocks) - 1
+            for i in cfg.endpoints)
+
+        # backbone publishes its per-block widths — no re-derivation
+        widths = self.backbone.block_channels
+        level_ch = [widths[i] for i in self._endpoints]
+
+        extras = []
+        prev = level_ch[-1]
+        for ch in cfg.extra_channels:
+            extras.append(ConvBNLayer(prev, ch, 3, stride=2, act="relu"))
+            level_ch.append(ch)
+            prev = ch
+        self.extras = LayerList(extras)
+
+        n_levels = len(level_ch)
+        # per-level anchor sizes: linear min_ratio..max_ratio (SSD paper /
+        # reference multi_box_head min_ratio/max_ratio handling)
+        ratios = np.linspace(cfg.min_ratio, cfg.max_ratio, n_levels + 1)
+        self._sizes = [(float(ratios[i] * cfg.image_size),
+                        float(ratios[i + 1] * cfg.image_size))
+                       for i in range(n_levels)]
+        # must mirror prior_box's emission exactly: one min-size box,
+        # one per aspect ratio != 1.0, one sqrt(min*max) box
+        a_per_cell = 1 + sum(1 for ar in cfg.aspect_ratios
+                             if abs(ar - 1.0) >= 1e-6) + 1
+        self.loc_heads = LayerList([
+            Conv2D(ch, a_per_cell * 4, 3, padding=1) for ch in level_ch])
+        self.conf_heads = LayerList([
+            Conv2D(ch, a_per_cell * cfg.num_classes, 3, padding=1)
+            for ch in level_ch])
+        self._anchors = None   # built lazily at first trace (needs shapes)
+
+    def _feature_maps(self, params, x, training):
+        out, feats = self.backbone.features(
+            params["backbone"], x, training=training,
+            endpoints=self._endpoints)
+        levels = [feats[i] for i in self._endpoints]
+        y = out
+        for i, extra in enumerate(self.extras):
+            y = extra(params["extras"][str(i)], y, training=training)
+            levels.append(y)
+        return levels
+
+    def anchors(self, feature_shapes=None):
+        """(P, 4) normalized xyxy prior boxes across all levels."""
+        if self._anchors is not None and feature_shapes is None:
+            return self._anchors
+        s = self.cfg.image_size
+        if feature_shapes is None:
+            raise ValueError("first call needs feature_shapes")
+        per = []
+        for (h, w), (mn, mx) in zip(feature_shapes, self._sizes):
+            per.append(D.prior_box(
+                h, w, s, s, min_sizes=(mn,), max_sizes=(mx,),
+                aspect_ratios=self.cfg.aspect_ratios))
+        self._anchors = jnp.concatenate(per, axis=0)
+        return self._anchors
+
+    def forward(self, params, image, training=False):
+        levels = self._feature_maps(params, image, training)
+        locs, confs, shapes = [], [], []
+        for i, feat in enumerate(levels):
+            b, h, w, _ = feat.shape
+            shapes.append((h, w))
+            loc = self.loc_heads[i](params["loc_heads"][str(i)], feat)
+            conf = self.conf_heads[i](params["conf_heads"][str(i)], feat)
+            locs.append(loc.reshape(b, -1, 4))
+            confs.append(conf.reshape(b, -1, self.cfg.num_classes))
+        self.anchors(shapes)
+        return jnp.concatenate(locs, 1), jnp.concatenate(confs, 1)
+
+    def loss(self, params, image, gt_boxes, gt_labels, gt_mask, *,
+             training=True, key=None):
+        del key
+        loc, conf = self.forward(params, image, training=training)
+        loss = D.ssd_loss(loc, conf, self._anchors, gt_boxes, gt_labels,
+                          gt_mask, variances=self.cfg.variances)
+        return loss, {}
+
+    def detect(self, params, image, *, score_threshold=0.01,
+               nms_threshold=0.45, max_per_class=20):
+        """Returns per-image (boxes (K, 4) normalized xyxy, cls (K,),
+        scores (K,), valid (K,)) with K = C * max_per_class."""
+        loc, conf = self.forward(params, image, training=False)
+
+        def one(loc_i, conf_i):
+            boxes = D.box_decode(loc_i, self._anchors,
+                                 self.cfg.variances)
+            probs = jax.nn.softmax(conf_i, -1)
+            cls_ids, idxs, valid = D.multiclass_nms(
+                boxes, probs[:, 1:],            # drop background column
+                iou_threshold=nms_threshold,
+                score_threshold=score_threshold,
+                max_per_class=max_per_class)
+            sel = jnp.where(valid, probs[idxs, cls_ids + 1], 0.0)
+            return boxes[idxs], cls_ids + 1, sel, valid
+
+        return jax.vmap(one)(loc, conf)

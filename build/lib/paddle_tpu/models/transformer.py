@@ -1,0 +1,324 @@
+"""Transformer enc-dec (base/big) for WMT en-de — BASELINE.json config[3].
+
+Reference recipe: PaddleNLP transformer (fluid builds it from layers/nn.py
+primitives + while_op beam search ``operators/*beam_search*``). TPU-native:
+flash-attention encoder/decoder stacks (nn/transformer.py), packed static
+shapes with padding masks instead of LoD ragged tensors (SURVEY.md §5.7),
+label-smoothed xent, greedy/incremental decode via lax.while_loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.nn.transformer import (TransformerDecoderLayer,
+                                       TransformerEncoderLayer)
+from paddle_tpu.ops import attention as ops_attn
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_heads: int = 8
+    ffn_size: int = 2048
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    max_len: int = 256
+    dropout: float = 0.1
+    attn_dropout: Optional[float] = None  # None = follow dropout; set 0
+                                          # to enable attn_impl="ring"
+    label_smoothing: float = 0.1
+    bos_id: int = 0
+    eos_id: int = 1
+    pad_id: int = 2
+    pre_ln: bool = True
+    attn_impl: str = "auto"
+
+    @classmethod
+    def big(cls, **kw):
+        """Transformer-big (Vaswani et al. table 3)."""
+        return cls(d_model=1024, num_heads=16, ffn_size=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("d_model", 16)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("ffn_size", 32)
+        kw.setdefault("num_encoder_layers", 2)
+        kw.setdefault("num_decoder_layers", 2)
+        kw.setdefault("max_len", 32)
+        return cls(**kw)
+
+
+def sinusoid_positions(max_len, dim):
+    pos = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    i = jnp.arange(dim // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / dim)
+    return jnp.concatenate([jnp.sin(angle), jnp.cos(angle)],
+                           axis=-1)  # (max_len, dim)
+
+
+class Transformer(Layer):
+    """Shared-vocab encoder-decoder with tied output projection."""
+
+    def __init__(self, cfg: TransformerConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed = Embedding(cfg.vocab_size, cfg.d_model,
+                               weight_init=I.normal(0.0, cfg.d_model ** -0.5))
+        self.drop = Dropout(cfg.dropout)
+        self.encoder = LayerList([
+            TransformerEncoderLayer(cfg.d_model, cfg.num_heads, cfg.ffn_size,
+                                    dropout=cfg.dropout,
+                                    attn_dropout=cfg.attn_dropout,
+                                    activation="relu", pre_ln=cfg.pre_ln,
+                                    attn_impl=cfg.attn_impl)
+            for _ in range(cfg.num_encoder_layers)])
+        self.decoder = LayerList([
+            TransformerDecoderLayer(cfg.d_model, cfg.num_heads, cfg.ffn_size,
+                                    dropout=cfg.dropout,
+                                    attn_dropout=cfg.attn_dropout,
+                                    activation="relu", pre_ln=cfg.pre_ln,
+                                    attn_impl=cfg.attn_impl)
+            for _ in range(cfg.num_decoder_layers)])
+        # pre-LN stacks need a final LayerNorm
+        self.enc_ln = LayerNorm(cfg.d_model)
+        self.dec_ln = LayerNorm(cfg.d_model)
+
+    def _embed(self, params, ids, key=None, training=False):
+        cfg = self.cfg
+        x = self.embed(params["embed"], ids) * math.sqrt(cfg.d_model)
+        x = x + sinusoid_positions(ids.shape[1], cfg.d_model)
+        return self.drop(None, x, key=key, training=training)
+
+    def encode(self, params, src_ids, *, key=None, training=False):
+        cfg = self.cfg
+        src_mask = src_ids != cfg.pad_id
+        bias = ops_attn.make_padding_bias(src_mask)
+        keys = ([None] * (cfg.num_encoder_layers + 1) if key is None
+                else list(jax.random.split(key, cfg.num_encoder_layers + 1)))
+        x = self._embed(params, src_ids, keys[0], training)
+        for i, layer in enumerate(self.encoder):
+            x = layer(params["encoder"][str(i)], x, bias=bias,
+                      key=keys[i + 1], training=training)
+        if cfg.pre_ln:
+            x = self.enc_ln(params["enc_ln"], x)
+        return x, bias
+
+    def decode(self, params, tgt_ids, memory, memory_bias, *, key=None,
+               training=False):
+        cfg = self.cfg
+        keys = ([None] * (cfg.num_decoder_layers + 1) if key is None
+                else list(jax.random.split(key, cfg.num_decoder_layers + 1)))
+        x = self._embed(params, tgt_ids, keys[0], training)
+        for i, layer in enumerate(self.decoder):
+            x = layer(params["decoder"][str(i)], x, memory,
+                      cross_bias=memory_bias, key=keys[i + 1],
+                      training=training)
+        if cfg.pre_ln:
+            x = self.dec_ln(params["dec_ln"], x)
+        # tied output projection
+        return jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+
+    def forward(self, params, src_ids, tgt_ids, *, key=None, training=False):
+        """Teacher-forced logits: (B, S_tgt, V)."""
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        memory, memory_bias = self.encode(params, src_ids, key=k1,
+                                          training=training)
+        return self.decode(params, tgt_ids, memory, memory_bias, key=k2,
+                           training=training)
+
+    def loss(self, params, src_ids, tgt_in, tgt_out, *, key=None,
+             training=True):
+        """tgt_in = [BOS, y...], tgt_out = [y..., EOS]; pad_id positions of
+        tgt_out are masked from the loss. Label smoothing per cfg."""
+        cfg = self.cfg
+        logits = self.forward(params, src_ids, tgt_in, key=key,
+                              training=training)
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt_out[..., None], axis=-1)[..., 0]
+        if cfg.label_smoothing > 0:
+            eps = cfg.label_smoothing
+            smooth = -logp.mean(axis=-1)
+            nll = (1 - eps) * nll + eps * smooth
+        mask = (tgt_out != cfg.pad_id).astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+        tok_acc = ((logits.argmax(-1) == tgt_out) * mask).sum() / denom
+        return loss, {"token_acc": tok_acc}
+
+    # -- packed variable-length training (data/packing.py) ----------------
+    #
+    # Fluid trains ragged WMT batches on LoD tensors; the TPU-native path
+    # packs many pairs into fixed (rows, S) slabs: segment ids gate
+    # attention (within-segment only; row-causality x same-segment =
+    # per-sequence causality since segments are contiguous), per-segment
+    # positions drive the sinusoid embedding, and shapes come from a
+    # bucket ladder so jit compiles O(#buckets) programs.
+
+    def _embed_packed(self, params, ids, pos, key=None, training=False):
+        cfg = self.cfg
+        x = self.embed(params["embed"], ids) * math.sqrt(cfg.d_model)
+        # per-segment positions are < the row length, so size the table by
+        # the packed bucket too (jnp.take would silently CLAMP positions
+        # past a too-small table)
+        table = sinusoid_positions(max(cfg.max_len, ids.shape[1]),
+                                   cfg.d_model)
+        x = x + jnp.take(table, pos, axis=0)
+        return self.drop(None, x, key=key, training=training)
+
+    def encode_packed(self, params, src, src_seg, src_pos, *, key=None,
+                      training=False):
+        from paddle_tpu.ops import sequence as seq_ops
+
+        cfg = self.cfg
+        bias = seq_ops.make_segment_attention_bias(src_seg)
+        keys = ([None] * (cfg.num_encoder_layers + 1) if key is None
+                else list(jax.random.split(key, cfg.num_encoder_layers + 1)))
+        x = self._embed_packed(params, src, src_pos, keys[0], training)
+        for i, layer in enumerate(self.encoder):
+            x = layer(params["encoder"][str(i)], x, bias=bias,
+                      key=keys[i + 1], training=training)
+        if cfg.pre_ln:
+            x = self.enc_ln(params["enc_ln"], x)
+        return x
+
+    def loss_packed(self, params, src, src_seg, src_pos, tgt_in, tgt_out,
+                    tgt_seg, tgt_pos, *, key=None, training=True):
+        """Packed teacher-forced loss; token-SUM and count are also
+        returned so callers can aggregate exactly across batches."""
+        from paddle_tpu.ops import sequence as seq_ops
+
+        cfg = self.cfg
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        memory = self.encode_packed(params, src, src_seg, src_pos, key=k1,
+                                    training=training)
+        # decoder self: same segment (the layer's causal=True supplies
+        # row-causality); cross: target segment matches source segment,
+        # padding (seg 0) queries see nothing real
+        self_bias = seq_ops.make_segment_attention_bias(tgt_seg)
+        cross_bias = seq_ops.make_segment_attention_bias(tgt_seg, src_seg)
+
+        keys = ([None] * (cfg.num_decoder_layers + 1) if k2 is None
+                else list(jax.random.split(k2, cfg.num_decoder_layers + 1)))
+        x = self._embed_packed(params, tgt_in, tgt_pos, keys[0], training)
+        for i, layer in enumerate(self.decoder):
+            x = layer(params["decoder"][str(i)], x, memory,
+                      self_bias=self_bias, cross_bias=cross_bias,
+                      key=keys[i + 1], training=training)
+        if cfg.pre_ln:
+            x = self.dec_ln(params["dec_ln"], x)
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt_out[..., None], axis=-1)[..., 0]
+        if cfg.label_smoothing > 0:
+            eps = cfg.label_smoothing
+            nll = (1 - eps) * nll + eps * (-logp.mean(axis=-1))
+        mask = (tgt_seg > 0).astype(jnp.float32)
+        tok_sum = (nll * mask).sum()
+        tok_count = mask.sum()
+        loss = tok_sum / jnp.maximum(tok_count, 1.0)
+        return loss, {"token_sum": tok_sum, "token_count": tok_count}
+
+    def greedy_decode(self, params, src_ids, max_len=None):
+        """Greedy generation (≙ reference beam_search with beam=1; full
+        beam search is an inference-path follow-up). Re-runs the decoder
+        per step under lax.while_loop — O(S^2) but static-shaped."""
+        cfg = self.cfg
+        max_len = max_len or cfg.max_len
+        b = src_ids.shape[0]
+        memory, memory_bias = self.encode(params, src_ids)
+        tgt = jnp.full((b, max_len), cfg.pad_id, jnp.int32)
+        tgt = tgt.at[:, 0].set(cfg.bos_id)
+        done = jnp.zeros((b,), bool)
+
+        def cond(carry):
+            t, _, done = carry
+            return (t < max_len - 1) & ~jnp.all(done)
+
+        def body(carry):
+            t, tgt, done = carry
+            logits = self.decode(params, tgt, memory, memory_bias)
+            nxt = logits[:, t].argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(done, cfg.pad_id, nxt)
+            tgt = tgt.at[:, t + 1].set(nxt)
+            done = done | (nxt == cfg.eos_id)
+            return t + 1, tgt, done
+
+        _, tgt, _ = jax.lax.while_loop(cond, body, (0, tgt, done))
+        return tgt
+
+    def beam_search_decode(self, params, src_ids, *, beam_size: int = 4,
+                           max_len: Optional[int] = None,
+                           length_penalty: float = 0.6):
+        """Beam search (reference ``beam_search_op`` + ``layers.beam_search``
+        machine-translation path). GNMT-style length normalization
+        ((5+len)/6)^alpha. Returns (best_ids (B, T), best_scores (B,))."""
+        cfg = self.cfg
+        max_len = max_len or cfg.max_len
+        b = src_ids.shape[0]
+        k = beam_size
+        v = cfg.vocab_size
+        NEG = -1e9
+
+        memory, memory_bias = self.encode(params, src_ids)
+        # expand memory to beams: (B*K, S, D)
+        mem = jnp.repeat(memory, k, axis=0)
+        mem_bias = jnp.repeat(memory_bias, k, axis=0)
+
+        tgt = jnp.full((b, k, max_len), cfg.pad_id, jnp.int32)
+        tgt = tgt.at[:, :, 0].set(cfg.bos_id)
+        # beam 0 active, others start at -inf so step 1 fans out
+        scores = jnp.tile(jnp.array([0.0] + [NEG] * (k - 1)), (b, 1))
+        done = jnp.zeros((b, k), bool)
+
+        def penalty(length):
+            return ((5.0 + length) / 6.0) ** length_penalty
+
+        def body(t, carry):
+            tgt, scores, done = carry
+            logits = self.decode(params, tgt.reshape(b * k, max_len),
+                                 mem, mem_bias)[:, t]          # (B*K, V)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logp = logp.reshape(b, k, v)
+            # finished beams: only PAD continuation, score unchanged
+            pad_only = jnp.full((v,), NEG).at[cfg.pad_id].set(0.0)
+            logp = jnp.where(done[..., None], pad_only[None, None, :], logp)
+            cand = scores[..., None] + logp                    # (B, K, V)
+            flat = cand.reshape(b, k * v)
+            new_scores, idx = jax.lax.top_k(flat, k)           # (B, K)
+            src_beam = idx // v
+            tok = (idx % v).astype(jnp.int32)
+            tgt = jnp.take_along_axis(tgt, src_beam[..., None], axis=1)
+            tgt = tgt.at[:, :, t + 1].set(tok)
+            done = jnp.take_along_axis(done, src_beam, axis=1)
+            done = done | (tok == cfg.eos_id)
+            return tgt, new_scores, done
+
+        tgt, scores, done = jax.lax.fori_loop(
+            0, max_len - 1, body, (tgt, scores, done))
+        # length-normalized final ranking
+        lengths = (tgt != cfg.pad_id).sum(-1).astype(jnp.float32)
+        norm = scores / penalty(lengths)
+        best = jnp.argmax(norm, axis=1)
+        best_ids = jnp.take_along_axis(
+            tgt, best[:, None, None], axis=1)[:, 0]
+        best_scores = jnp.take_along_axis(norm, best[:, None], 1)[:, 0]
+        return best_ids, best_scores

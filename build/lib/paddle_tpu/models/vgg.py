@@ -1,0 +1,93 @@
+"""VGG-11/13/16/19 — PaddleCV image_classification zoo parity (reference
+``vgg.py`` built on fluid ``img_conv_group``; also the book chapter 03
+image-classification CNN). NHWC, BN variant optional (the reference's
+vgg uses plain conv+relu; PaddleCV ships both)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import BatchNorm, Conv2D, Linear, Pool2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import nn as ops_nn
+
+_CFGS = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class _ConvBlock(Layer):
+    def __init__(self, in_ch, out_ch, reps, batch_norm):
+        super().__init__()
+        convs, bns = [], []
+        prev = in_ch
+        for _ in range(reps):
+            convs.append(Conv2D(prev, out_ch, 3, padding=1,
+                                bias=not batch_norm))
+            if batch_norm:
+                bns.append(BatchNorm(out_ch))
+            prev = out_ch
+        self.convs = LayerList(convs)
+        self.bns = LayerList(bns) if batch_norm else None
+        self.pool = Pool2D(2, stride=2, pool_type="max")
+
+    def forward(self, params, x, training=False):
+        for i, conv in enumerate(self.convs):
+            x = conv(params["convs"][str(i)], x)
+            if self.bns is not None:
+                x = self.bns[i](params["bns"][str(i)], x,
+                                training=training)
+            x = jax.nn.relu(x)
+        return self.pool(None, x)
+
+
+class VGG(Layer):
+    """``width`` scales channels (64 standard); tiny widths for tests."""
+
+    def __init__(self, depth=16, num_classes=1000, width=64, in_ch=3,
+                 batch_norm=True, fc_dim=4096, dropout=0.5):
+        super().__init__()
+        if depth not in _CFGS:
+            raise ValueError(f"depth must be one of {sorted(_CFGS)}")
+        blocks = []
+        prev = in_ch
+        for stage, reps in enumerate(_CFGS[depth]):
+            out = width * (2 ** min(stage, 3))
+            blocks.append(_ConvBlock(prev, out, reps, batch_norm))
+            prev = out
+        self.blocks = LayerList(blocks)
+        self.out_ch = prev
+        self.fc1 = Linear(prev, fc_dim, sharding=None)
+        self.fc2 = Linear(fc_dim, fc_dim, sharding=None)
+        self.fc3 = Linear(fc_dim, num_classes,
+                          weight_init=I.msra_uniform(fan_in=fc_dim),
+                          sharding=None)
+        self.dropout = dropout
+
+    def forward(self, params, x, training=False, key=None):
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))   # GAP replaces the 7x7 flatten
+        x = jax.nn.relu(self.fc1(params["fc1"], x))
+        if training and key is not None and self.dropout > 0:
+            k1, k2 = jax.random.split(key)
+            x = ops_nn.dropout(x, k1, rate=self.dropout, training=True)
+        x = jax.nn.relu(self.fc2(params["fc2"], x))
+        if training and key is not None and self.dropout > 0:
+            x = ops_nn.dropout(x, k2, rate=self.dropout, training=True)
+        return self.fc3(params["fc3"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training, key=key),
+            label)
+
+
+def VGG16(num_classes=1000, **kw):
+    return VGG(16, num_classes=num_classes, **kw)

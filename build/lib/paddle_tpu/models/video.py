@@ -1,0 +1,96 @@
+"""Video models — PaddleCV video zoo parity (TSN segment networks and a
+C3D-style volumetric convnet; the reference builds these on fluid conv2d/
+conv3d + pool, models repo PaddleCV/video). TPU-native: NDHWC volumetric
+convs from ``ops.nn.conv3d`` (XLA lowers them onto the MXU), TSN folds
+segments into the batch dim (one big MXU-friendly 2-D conv batch)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.common import classification_loss
+from paddle_tpu.models.mobilenet import MobileNetV1
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import BatchNorm, Linear
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import nn as ops_nn
+
+
+class TSN(Layer):
+    """Temporal Segment Network: a 2-D backbone runs per segment frame
+    (segments folded into batch), logits average across segments
+    ("segment consensus"). ``x``: (B, S, H, W, C)."""
+
+    def __init__(self, num_classes=400, num_segments=3, scale=0.25):
+        super().__init__()
+        self.num_segments = num_segments
+        self.backbone = MobileNetV1(num_classes=num_classes, scale=scale)
+
+    def forward(self, params, x, training=False):
+        b, s, h, w, c = x.shape
+        flat = x.reshape(b * s, h, w, c)
+        logits = self.backbone(params["backbone"], flat,
+                               training=training)
+        return logits.reshape(b, s, -1).mean(axis=1)   # consensus
+
+    def loss(self, params, video, label, *, training=True):
+        return classification_loss(
+            self.forward(params, video, training=training), label)
+
+
+class _Conv3DBN(Layer):
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1):
+        super().__init__()
+        kd = kernel if isinstance(kernel, tuple) else (kernel,) * 3
+        fan_in = in_ch * kd[0] * kd[1] * kd[2]
+        self.weight = self.create_parameter(
+            "weight", kd + (in_ch, out_ch),
+            initializer=I.msra_normal(fan_in=fan_in))
+        self.bn = BatchNorm(out_ch)
+        self.stride = stride
+        self.padding = tuple(k // 2 for k in kd)   # shape-preserving
+
+    def forward(self, params, x, training=False):
+        y = ops_nn.conv3d(x, params["weight"], stride=self.stride,
+                          padding=self.padding)
+        # BatchNorm normalizes the trailing channel dim; NDHWC folds the
+        # depth axis into the spatial dims it already averages over
+        b, d, h, w, c = y.shape
+        y = self.bn(params["bn"], y.reshape(b, d * h, w, c),
+                    training=training).reshape(b, d, h, w, c)
+        return jax.nn.relu(y)
+
+
+class C3D(Layer):
+    """C3D-style volumetric convnet: stacked 3x3x3 conv-BN-relu blocks
+    with progressive spatio-temporal pooling. ``x``: (B, D, H, W, C)."""
+
+    CFG = [(64, (1, 2, 2)), (128, (2, 2, 2)), (256, (2, 2, 2)),
+           (256, (2, 2, 2))]
+
+    def __init__(self, num_classes=101, in_ch=3, width_scale=1.0):
+        super().__init__()
+        blocks = []
+        prev = in_ch
+        self._pools = []
+        for ch, pool in self.CFG:
+            ch = max(8, int(ch * width_scale))
+            blocks.append(_Conv3DBN(prev, ch))
+            self._pools.append(pool)
+            prev = ch
+        self.blocks = LayerList(blocks)
+        self.fc = Linear(prev, num_classes,
+                         weight_init=I.msra_uniform(fan_in=prev),
+                         sharding=None)
+
+    def forward(self, params, x, training=False):
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+            x = ops_nn.pool3d(x, self._pools[i], pool_type="max")
+        x = x.mean(axis=(1, 2, 3))                     # global avg pool
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, video, label, *, training=True):
+        return classification_loss(
+            self.forward(params, video, training=training), label)

@@ -1,0 +1,134 @@
+"""YOLOv3 detector — PaddleCV yolov3 parity: multi-scale one-stage
+detection over a MobileNet backbone with per-scale anchor-masked heads,
+trained with ``ops.detection.yolov3_loss`` and decoded with ``yolo_box``
+(+ per-class NMS). The reference composes the same ops
+(fluid.layers.yolov3_loss / yolo_box, operators/detection/yolov3_loss_op,
+yolo_box_op) over a DarkNet body."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.mobilenet import MobileNetV1
+from paddle_tpu.models.resnet import ConvBNLayer
+from paddle_tpu.nn.layers import Conv2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.ops import detection as D
+
+
+@dataclasses.dataclass
+class YOLOv3Config:
+    num_classes: int = 80
+    # advisory only: loss/detect derive every scale from the actual input
+    # tensor, so any (stride-32-divisible) size works at call time
+    image_size: int = 416
+    backbone_scale: float = 1.0
+    # COCO anchors (w, h) pixels; masks pick 3 per scale, big -> small
+    anchors: Tuple[Tuple[int, int], ...] = (
+        (10, 13), (16, 30), (33, 23), (30, 61), (62, 45), (59, 119),
+        (116, 90), (156, 198), (373, 326))
+    anchor_masks: Tuple[Tuple[int, ...], ...] = ((6, 7, 8), (3, 4, 5),
+                                                (0, 1, 2))
+    # backbone endpoints for strides (32, 16, 8)
+    endpoints: Tuple[int, ...] = (-1, 10, 4)
+    ignore_thresh: float = 0.7
+
+    @classmethod
+    def tiny(cls, num_classes=4, image_size=64):
+        return cls(num_classes=num_classes, image_size=image_size,
+                   backbone_scale=0.125,
+                   anchors=((8, 8), (16, 16), (32, 32), (48, 48)),
+                   anchor_masks=((2, 3), (0, 1)),
+                   endpoints=(-1, 10))
+
+
+class YOLOv3(Layer):
+    """Heads output NCHW (B, A*(5+C), H, W) — the reference layout that
+    yolov3_loss/yolo_box consume."""
+
+    def __init__(self, cfg: YOLOv3Config):
+        super().__init__()
+        self.cfg = cfg
+        self.backbone = MobileNetV1(num_classes=1,
+                                    scale=cfg.backbone_scale)
+        n_blocks = len(self.backbone.blocks)
+        self._endpoints = tuple(i if i >= 0 else n_blocks - 1
+                                for i in cfg.endpoints)
+
+        widths = self.backbone.block_channels
+        heads, necks = [], []
+        for lvl, ep in enumerate(self._endpoints):
+            in_ch = widths[ep]
+            a = len(cfg.anchor_masks[lvl])
+            necks.append(ConvBNLayer(in_ch, in_ch, 3, act="relu"))
+            heads.append(Conv2D(in_ch, a * (5 + cfg.num_classes), 1))
+        self.necks = LayerList(necks)
+        self.heads = LayerList(heads)
+
+    def forward(self, params, image, training=False):
+        """-> list of per-scale raw heads, NCHW (B, A*(5+C), H, W)."""
+        _, feats = self.backbone.features(
+            params["backbone"], image, training=training,
+            endpoints=self._endpoints)
+        outs = []
+        for i, ep in enumerate(self._endpoints):
+            h = self.necks[i](params["necks"][str(i)], feats[ep],
+                              training=training)
+            y = self.heads[i](params["heads"][str(i)], h)
+            outs.append(jnp.transpose(y, (0, 3, 1, 2)))   # NHWC -> NCHW
+        return outs
+
+    def loss(self, params, image, gt_boxes, gt_labels, gt_mask, *,
+             training=True, key=None):
+        """gt_boxes (B, G, 4) normalized (cx, cy, w, h) — the reference's
+        yolov3 gt layout."""
+        del key
+        cfg = self.cfg
+        heads = self.forward(params, image, training=training)
+        img_w = image.shape[2]                 # NHWC: derive from input
+        total = 0.0
+        for lvl, head in enumerate(heads):
+            downsample = img_w // head.shape[-1]
+            total = total + D.yolov3_loss(
+                head, gt_boxes, gt_labels, gt_mask,
+                anchors=cfg.anchors,
+                anchor_mask=cfg.anchor_masks[lvl],
+                class_num=cfg.num_classes,
+                ignore_thresh=cfg.ignore_thresh,
+                downsample_ratio=downsample)
+        return total, {}
+
+    def detect(self, params, image, *, score_threshold=0.01,
+               nms_threshold=0.45, max_per_class=20):
+        """-> per image (boxes (K, 4) pixel xyxy, cls, scores, valid)."""
+        cfg = self.cfg
+        heads = self.forward(params, image, training=False)
+        b, img_h, img_w = image.shape[0], image.shape[1], image.shape[2]
+        img_size = jnp.tile(jnp.asarray([[img_h, img_w]], jnp.int32),
+                            (b, 1))
+        all_boxes, all_scores = [], []
+        for lvl, head in enumerate(heads):
+            downsample = img_w // head.shape[-1]
+            anchors_lvl = [cfg.anchors[i] for i in cfg.anchor_masks[lvl]]
+            boxes, scores = D.yolo_box(
+                head, img_size, anchors_lvl, cfg.num_classes,
+                conf_thresh=score_threshold,
+                downsample_ratio=downsample)
+            all_boxes.append(boxes)
+            all_scores.append(scores)
+        boxes = jnp.concatenate(all_boxes, 1)      # (B, P, 4)
+        scores = jnp.concatenate(all_scores, 1)    # (B, P, C)
+
+        def one(boxes_i, scores_i):
+            cls_ids, idxs, valid = D.multiclass_nms(
+                boxes_i, scores_i, iou_threshold=nms_threshold,
+                score_threshold=score_threshold,
+                max_per_class=max_per_class)
+            sel = jnp.where(valid, scores_i[idxs, cls_ids], 0.0)
+            return boxes_i[idxs], cls_ids, sel, valid
+
+        return jax.vmap(one)(boxes, scores)

@@ -1,0 +1,47 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its data pipeline, trainers, and serving shells in C++
+(SURVEY.md §2.1); this package holds their TPU-native equivalents compiled
+as C-ABI shared libraries bound via ctypes (no pybind11 in this image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def build_library(name: str, sources, extra_flags=()) -> str:
+    """Compile sources into _build/lib<name>.so if stale; returns path."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_DIR, s) for s in sources]
+    if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+        return out
+    # compile to a temp name, then atomic-rename: a concurrent process must
+    # never dlopen a half-written .so
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *extra_flags, *srcs, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed: {' '.join(cmd)}\n"
+                           f"{proc.stderr}")
+    os.replace(tmp, out)
+    return out
+
+
+def load_library(name: str, sources, extra_flags=()) -> ctypes.CDLL:
+    with _LOCK:
+        if name not in _LIBS:
+            _LIBS[name] = ctypes.CDLL(
+                build_library(name, sources, extra_flags))
+        return _LIBS[name]

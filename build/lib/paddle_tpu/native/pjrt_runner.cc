@@ -1,0 +1,455 @@
+// Native PJRT inference runner: the C++ serving shell.
+//
+// Reference mapping: fluid serving is a C++ stack — AnalysisPredictor
+// (inference/api/analysis_predictor.h:47) loads a ProgramDesc + params,
+// runs analysis passes, and serves a zero-copy run loop, with a C API
+// (inference/capi/) for other languages. TPU-native redesign: the
+// "__model__" is portable StableHLO (saved by paddle_tpu.inference); this
+// runner dlopens any PJRT C-API plugin (libtpu.so for TPU, or any
+// GetPjrtApi-exporting .so), compiles the module ONCE (XLA replaces the
+// analysis/fuse pass pipeline), and serves execute calls over a C ABI —
+// host-side serving loop in C++, compute in XLA, no Python in the loop.
+//
+// The PJRT C API is a stable struct table (pjrt_c_api.h, vendored by the
+// local TF/XLA install); every call follows the args-struct protocol with
+// struct_size set by the caller.
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct Runner {
+  void* dso = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+};
+
+struct Exec {
+  PJRT_LoadedExecutable* loaded = nullptr;
+  int num_outputs = 0;
+};
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, errlen, "%s", msg.c_str());
+  }
+}
+
+// Returns true if e is an error (and fills err/destroys e).
+bool check(const PJRT_Api* api, PJRT_Error* e, const char* where, char* err,
+           int errlen) {
+  if (e == nullptr) return false;
+  PJRT_Error_Message_Args m;
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.extension_start = nullptr;
+  m.error = e;
+  api->PJRT_Error_Message(&m);
+  set_err(err, errlen, std::string(where) + ": " +
+                           std::string(m.message, m.message_size));
+  PJRT_Error_Destroy_Args d;
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.extension_start = nullptr;
+  d.error = e;
+  api->PJRT_Error_Destroy(&d);
+  return true;
+}
+
+bool await_event(const PJRT_Api* api, PJRT_Event* ev, const char* where,
+                 char* err, int errlen) {
+  PJRT_Event_Await_Args aw;
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.extension_start = nullptr;
+  aw.event = ev;
+  PJRT_Error* e = api->PJRT_Event_Await(&aw);
+  bool failed = check(api, e, where, err, errlen);
+  PJRT_Event_Destroy_Args dv;
+  dv.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dv.extension_start = nullptr;
+  dv.event = ev;
+  api->PJRT_Event_Destroy(&dv);
+  return failed;
+}
+
+// paddle_tpu dtype codes (keep in sync with native/pjrt.py)
+PJRT_Buffer_Type to_pjrt_type(int code) {
+  switch (code) {
+    case 0: return PJRT_Buffer_Type_F32;
+    case 1: return PJRT_Buffer_Type_F64;
+    case 2: return PJRT_Buffer_Type_S32;
+    case 3: return PJRT_Buffer_Type_S64;
+    case 4: return PJRT_Buffer_Type_PRED;
+    case 5: return PJRT_Buffer_Type_BF16;
+    case 6: return PJRT_Buffer_Type_F16;
+    case 7: return PJRT_Buffer_Type_U8;
+    case 8: return PJRT_Buffer_Type_S8;
+    default: return PJRT_Buffer_Type_INVALID;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pjr_destroy(void* h);
+void pjr_exec_destroy(void* h, void* hexec);
+
+// Loads a PJRT plugin and creates a client. Plugin-specific create
+// options arrive as parallel arrays (kinds[i]: 0 = string -> str_vals[i],
+// 1 = int64 -> int_vals[i]); libtpu and other plugins take tuning knobs
+// this way. Returns nullptr on failure (err filled).
+void* pjr_create_with_options(const char* plugin_path, int n_opts,
+                              const char** opt_names,
+                              const char** str_vals,
+                              const int64_t* int_vals, const int* kinds,
+                              char* err, int errlen) {
+  Runner* r = new Runner();
+  r->dso = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (!r->dso) {
+    set_err(err, errlen, std::string("dlopen failed: ") + dlerror());
+    delete r;
+    return nullptr;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(r->dso, "GetPjrtApi"));
+  if (!get_api) {
+    set_err(err, errlen, "plugin has no GetPjrtApi symbol");
+    dlclose(r->dso);
+    delete r;
+    return nullptr;
+  }
+  r->api = get_api();
+  if (!r->api) {
+    set_err(err, errlen, "GetPjrtApi returned null");
+    dlclose(r->dso);
+    delete r;
+    return nullptr;
+  }
+
+  PJRT_Plugin_Initialize_Args init;
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  init.extension_start = nullptr;
+  if (check(r->api, r->api->PJRT_Plugin_Initialize(&init),
+            "PJRT_Plugin_Initialize", err, errlen)) {
+    delete r;
+    return nullptr;
+  }
+
+  std::vector<PJRT_NamedValue> opts(n_opts);
+  for (int i = 0; i < n_opts; ++i) {
+    std::memset(&opts[i], 0, sizeof(PJRT_NamedValue));
+    opts[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    opts[i].name = opt_names[i];
+    opts[i].name_size = std::strlen(opt_names[i]);
+    if (kinds[i] == 0) {
+      opts[i].type = PJRT_NamedValue_kString;
+      opts[i].string_value = str_vals[i];
+      opts[i].value_size = std::strlen(str_vals[i]);
+    } else {
+      opts[i].type = PJRT_NamedValue_kInt64;
+      opts[i].int64_value = int_vals[i];
+      opts[i].value_size = 1;
+    }
+  }
+
+  PJRT_Client_Create_Args c;
+  std::memset(&c, 0, sizeof(c));
+  c.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  c.create_options = opts.data();
+  c.num_options = static_cast<size_t>(n_opts);
+  if (check(r->api, r->api->PJRT_Client_Create(&c), "PJRT_Client_Create",
+            err, errlen)) {
+    delete r;
+    return nullptr;
+  }
+  r->client = c.client;
+
+  PJRT_Client_AddressableDevices_Args d;
+  d.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  d.extension_start = nullptr;
+  d.client = r->client;
+  bool dev_failed = check(r->api, r->api->PJRT_Client_AddressableDevices(&d),
+                          "AddressableDevices", err, errlen);
+  if (!dev_failed && d.num_addressable_devices == 0) {
+    set_err(err, errlen, "no addressable devices");
+    dev_failed = true;
+  }
+  if (dev_failed) {
+    pjr_destroy(r);  // destroys the live client too
+    return nullptr;
+  }
+  r->device = d.addressable_devices[0];
+  return r;
+}
+
+void* pjr_create(const char* plugin_path, char* err, int errlen) {
+  return pjr_create_with_options(plugin_path, 0, nullptr, nullptr, nullptr,
+                                 nullptr, err, errlen);
+}
+
+void pjr_destroy(void* h) {
+  Runner* r = static_cast<Runner*>(h);
+  if (!r) return;
+  if (r->client) {
+    PJRT_Client_Destroy_Args d;
+    d.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    d.extension_start = nullptr;
+    d.client = r->client;
+    r->api->PJRT_Client_Destroy(&d);
+  }
+  // NOTE: the plugin dso is intentionally NOT dlclosed — PJRT plugins
+  // commonly register global state that does not survive unload.
+  delete r;
+}
+
+// Compile a StableHLO (MLIR bytecode) module. compile_options is a
+// serialized CompileOptionsProto (written at export time by the Python
+// side via jaxlib). Returns an executable handle or nullptr.
+void* pjr_compile(void* h, const char* code, int64_t code_size,
+                  const char* copts, int64_t copts_size, char* err,
+                  int errlen) {
+  Runner* r = static_cast<Runner*>(h);
+  PJRT_Program prog;
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.extension_start = nullptr;
+  prog.code = const_cast<char*>(code);
+  prog.code_size = static_cast<size_t>(code_size);
+  static const char kFormat[] = "mlir";
+  prog.format = kFormat;
+  prog.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args c;
+  c.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  c.extension_start = nullptr;
+  c.client = r->client;
+  c.program = &prog;
+  c.compile_options = copts;
+  c.compile_options_size = static_cast<size_t>(copts_size);
+  if (check(r->api, r->api->PJRT_Client_Compile(&c), "PJRT_Client_Compile",
+            err, errlen)) {
+    return nullptr;
+  }
+
+  Exec* ex = new Exec();
+  ex->loaded = c.executable;
+
+  PJRT_LoadedExecutable_GetExecutable_Args g;
+  g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  g.extension_start = nullptr;
+  g.loaded_executable = ex->loaded;
+  if (check(r->api, r->api->PJRT_LoadedExecutable_GetExecutable(&g),
+            "GetExecutable", err, errlen)) {
+    pjr_exec_destroy(h, ex);  // release the compiled executable too
+    return nullptr;
+  }
+  PJRT_Executable_NumOutputs_Args n;
+  n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  n.extension_start = nullptr;
+  n.executable = g.executable;
+  bool failed = check(r->api, r->api->PJRT_Executable_NumOutputs(&n),
+                      "NumOutputs", err, errlen);
+  PJRT_Executable_Destroy_Args xd;
+  xd.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  xd.extension_start = nullptr;
+  xd.executable = g.executable;
+  r->api->PJRT_Executable_Destroy(&xd);
+  if (failed) {
+    pjr_exec_destroy(h, ex);
+    return nullptr;
+  }
+  ex->num_outputs = static_cast<int>(n.num_outputs);
+  return ex;
+}
+
+int pjr_num_outputs(void* hexec) {
+  return static_cast<Exec*>(hexec)->num_outputs;
+}
+
+void pjr_exec_destroy(void* h, void* hexec) {
+  Runner* r = static_cast<Runner*>(h);
+  Exec* ex = static_cast<Exec*>(hexec);
+  if (!ex) return;
+  if (ex->loaded) {
+    PJRT_LoadedExecutable_Destroy_Args d;
+    d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    d.extension_start = nullptr;
+    d.executable = ex->loaded;
+    r->api->PJRT_LoadedExecutable_Destroy(&d);
+  }
+  delete ex;
+}
+
+// Synchronous execute: stage inputs host->device, run, copy outputs back
+// into caller-allocated buffers. Single-device serving (the multi-chip
+// path belongs to jit/GSPMD, not the serving shell).
+//   dims_flat: concatenated dims per input, lengths in ranks[].
+//   out_bufs/out_sizes: caller-allocated, out_sizes in bytes.
+// Returns 0 on success, -1 on error (err filled).
+int pjr_execute(void* h, void* hexec, int n_in, const void** in_bufs,
+                const int64_t* dims_flat, const int* ranks,
+                const int* dtypes, int n_out, void** out_bufs,
+                const int64_t* out_sizes, char* err, int errlen) {
+  Runner* r = static_cast<Runner*>(h);
+  Exec* ex = static_cast<Exec*>(hexec);
+  if (n_out != ex->num_outputs) {
+    set_err(err, errlen, "output arity mismatch: executable has " +
+                             std::to_string(ex->num_outputs) + ", caller " +
+                             std::to_string(n_out));
+    return -1;
+  }
+
+  std::vector<PJRT_Buffer*> in(n_in, nullptr);
+  std::vector<PJRT_Buffer*> out(n_out, nullptr);
+  int rc = -1;
+  int dim_off = 0;
+
+  // ---- stage inputs
+  for (int i = 0; i < n_in; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args b;
+    std::memset(&b, 0, sizeof(b));
+    b.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    b.client = r->client;
+    b.data = in_bufs[i];
+    b.type = to_pjrt_type(dtypes[i]);
+    if (b.type == PJRT_Buffer_Type_INVALID) {
+      set_err(err, errlen, "unsupported input dtype code " +
+                               std::to_string(dtypes[i]));
+      goto done;
+    }
+    b.dims = dims_flat + dim_off;
+    b.num_dims = static_cast<size_t>(ranks[i]);
+    dim_off += ranks[i];
+    // copied out synchronously during the call: caller buffers free after
+    b.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+    b.device = r->device;
+    if (check(r->api, r->api->PJRT_Client_BufferFromHostBuffer(&b),
+              "BufferFromHostBuffer", err, errlen)) {
+      goto done;
+    }
+    in[i] = b.buffer;
+    if (b.done_with_host_buffer) {
+      if (await_event(r->api, b.done_with_host_buffer, "host buffer done",
+                      err, errlen)) {
+        goto done;
+      }
+    }
+  }
+
+  // ---- execute
+  {
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    PJRT_Buffer* const* arg_list[1] = {in.data()};
+    PJRT_Buffer** out_list[1] = {out.data()};
+    PJRT_Event* done[1] = {nullptr};
+
+    PJRT_LoadedExecutable_Execute_Args e;
+    std::memset(&e, 0, sizeof(e));
+    e.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    e.executable = ex->loaded;
+    e.options = &opts;
+    e.argument_lists = arg_list;
+    e.num_devices = 1;
+    e.num_args = static_cast<size_t>(n_in);
+    e.output_lists = out_list;
+    e.device_complete_events = done;
+    e.execute_device = r->device;
+    if (check(r->api, r->api->PJRT_LoadedExecutable_Execute(&e), "Execute",
+              err, errlen)) {
+      goto done;
+    }
+    if (done[0] != nullptr &&
+        await_event(r->api, done[0], "device completion", err, errlen)) {
+      goto done;
+    }
+  }
+
+  // ---- fetch outputs
+  for (int i = 0; i < n_out; ++i) {
+    // the device may hold the result in a transposed/tiled physical
+    // layout; request an explicit dense row-major host copy (numpy
+    // convention: minor-to-major = [rank-1 .. 0], no tiles)
+    PJRT_Buffer_Dimensions_Args bd;
+    bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    bd.extension_start = nullptr;
+    bd.buffer = out[i];
+    if (check(r->api, r->api->PJRT_Buffer_Dimensions(&bd), "Dimensions",
+              err, errlen)) {
+      goto done;
+    }
+    std::vector<int64_t> m2m(bd.num_dims);
+    for (size_t j = 0; j < bd.num_dims; ++j) {
+      m2m[j] = static_cast<int64_t>(bd.num_dims) - 1 - static_cast<int64_t>(j);
+    }
+    PJRT_Buffer_MemoryLayout layout;
+    std::memset(&layout, 0, sizeof(layout));
+    layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+    layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+    layout.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+    layout.tiled.minor_to_major = m2m.data();
+    layout.tiled.minor_to_major_size = bd.num_dims;
+
+    PJRT_Buffer_ToHostBuffer_Args t;
+    t.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    t.extension_start = nullptr;
+    t.src = out[i];
+    t.host_layout = &layout;
+    t.dst = nullptr;  // query required size first
+    t.dst_size = 0;
+    t.event = nullptr;
+    if (check(r->api, r->api->PJRT_Buffer_ToHostBuffer(&t), "ToHost(size)",
+              err, errlen)) {
+      goto done;
+    }
+    if (t.dst_size != static_cast<size_t>(out_sizes[i])) {
+      set_err(err, errlen,
+              "output " + std::to_string(i) + " size mismatch: device " +
+                  std::to_string(t.dst_size) + "B, caller " +
+                  std::to_string(out_sizes[i]) + "B");
+      goto done;
+    }
+    t.dst = out_bufs[i];
+    if (check(r->api, r->api->PJRT_Buffer_ToHostBuffer(&t), "ToHost", err,
+              errlen)) {
+      goto done;
+    }
+    if (t.event != nullptr &&
+        await_event(r->api, t.event, "copy to host", err, errlen)) {
+      goto done;
+    }
+  }
+  rc = 0;
+
+done:
+  for (PJRT_Buffer* b : in) {
+    if (b) {
+      PJRT_Buffer_Destroy_Args d;
+      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      d.extension_start = nullptr;
+      d.buffer = b;
+      r->api->PJRT_Buffer_Destroy(&d);
+    }
+  }
+  for (PJRT_Buffer* b : out) {
+    if (b) {
+      PJRT_Buffer_Destroy_Args d;
+      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      d.extension_start = nullptr;
+      d.buffer = b;
+      r->api->PJRT_Buffer_Destroy(&d);
+    }
+  }
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,25 @@
+"""Module/Layer system + standard layers."""
+
+from paddle_tpu.nn import initializer
+from paddle_tpu.nn.module import (Layer, LayerList, ParamSpec, Sequential,
+                                  apply_state_updates, capture_state,
+                                  report_state)
+from paddle_tpu.nn.layers import (FC, BatchNorm, Conv2D, Dropout, Embedding,
+                                  LayerNorm, Linear, Pool2D)
+from paddle_tpu.nn.transformer import (FeedForward, MultiHeadAttention,
+                                       TransformerDecoderLayer,
+                                       TransformerEncoderLayer)
+from paddle_tpu.nn.moe import MoEFeedForward
+from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, LSTMPCell,
+                               RNN, SimpleRNNCell)
+
+__all__ = [
+    "initializer", "Layer", "LayerList", "ParamSpec", "Sequential",
+    "apply_state_updates", "capture_state", "report_state",
+    "FC", "BatchNorm", "Conv2D", "Dropout", "Embedding", "LayerNorm",
+    "Linear", "Pool2D",
+    "FeedForward", "MultiHeadAttention", "TransformerDecoderLayer",
+    "TransformerEncoderLayer",
+    "MoEFeedForward", "BiRNN", "GRUCell", "LSTM", "LSTMCell", "LSTMPCell",
+    "RNN", "SimpleRNNCell",
+]

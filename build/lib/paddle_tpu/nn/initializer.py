@@ -1,0 +1,102 @@
+"""Parameter initializers.
+
+Parity surface: ``python/paddle/fluid/initializer.py`` (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear). Implemented as
+``(key, shape, dtype) -> jax.Array`` callables so Layer.init stays functional.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def constant(value=0.0):
+    def init(key, shape, dtype=jnp.float32):
+        del key
+        return jnp.full(shape, value, dtype=dtype)
+
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def uniform(low=-1.0, high=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=low, maxval=high).astype(dtype)
+
+    return init
+
+
+def normal(mean=0.0, std=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return (mean + std * jax.random.normal(key, shape)).astype(dtype)
+
+    return init
+
+
+def truncated_normal(mean=0.0, std=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return (mean + std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape)).astype(dtype)
+
+    return init
+
+
+def _fans(shape, fan_in=None, fan_out=None):
+    # Conv kernels here are HWIO; dense kernels are (in, out).
+    if fan_in is not None and fan_out is not None:
+        return fan_in, fan_out
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def xavier_uniform(fan_in=None, fan_out=None):
+    """Xavier/Glorot (reference XavierInitializer, initializer.py)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape, fan_in, fan_out)
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+    return init
+
+
+def xavier_normal(fan_in=None, fan_out=None):
+    def init(key, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape, fan_in, fan_out)
+        std = math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+
+    return init
+
+
+def msra_uniform(fan_in=None):
+    """Kaiming/He (reference MSRAInitializer)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape, fan_in, None)
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(key, shape, minval=-limit,
+                                  maxval=limit).astype(dtype)
+
+    return init
+
+
+def msra_normal(fan_in=None):
+    def init(key, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape, fan_in, None)
+        std = math.sqrt(2.0 / fi)
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+
+    return init

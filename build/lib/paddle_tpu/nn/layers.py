@@ -1,0 +1,170 @@
+"""Standard layers (parity with fluid dygraph ``nn.py``: Conv2D, FC/Linear,
+BatchNorm, LayerNorm, Embedding, Dropout, Pool2D — dygraph/nn.py — and the
+static ``layers/nn.py`` builders fc:231, embedding:485, conv2d:2417,
+batch_norm:3871, layer_norm:4332)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import Layer, report_state
+from paddle_tpu.ops import nn as ops_nn
+from paddle_tpu.ops import math as ops_math
+
+
+class Linear(Layer):
+    """y = xW + b. Default TP sharding hint: W sharded over "tp" on the
+    output dim (Megatron column-parallel style); override via ``sharding``."""
+
+    def __init__(self, in_features, out_features, bias=True,
+                 weight_init=None, bias_init=None, sharding=P(None, "tp")):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            "weight", (in_features, out_features),
+            initializer=weight_init or I.xavier_uniform(), sharding=sharding)
+        self.has_bias = bias
+        if bias:
+            bspec = sharding[-1] if sharding is not None else None
+            self.bias = self.create_parameter(
+                "bias", (out_features,), initializer=bias_init or I.zeros,
+                sharding=P(bspec) if bspec else None)
+
+    def forward(self, params, x):
+        out = jnp.matmul(x, params["weight"])
+        if self.has_bias:
+            out = out + params["bias"]
+        return out
+
+
+FC = Linear  # fluid name
+
+
+class Conv2D(Layer):
+    """NHWC conv layer (fluid dygraph Conv2D; weights HWIO)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias=True, weight_init=None,
+                 data_format="NHWC"):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        fan_in = in_channels * kh * kw // groups
+        self.weight = self.create_parameter(
+            "weight", (kh, kw, in_channels // groups, out_channels),
+            initializer=weight_init or I.msra_normal(fan_in=fan_in))
+        self.has_bias = bias
+        if bias:
+            self.bias = self.create_parameter("bias", (out_channels,),
+                                              initializer=I.zeros)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.data_format = data_format
+
+    def forward(self, params, x):
+        return ops_nn.conv2d(
+            x, params["weight"], params["bias"] if self.has_bias else None,
+            stride=self.stride, padding=self.padding, dilation=self.dilation,
+            groups=self.groups, data_format=self.data_format)
+
+
+class Pool2D(Layer):
+    def __init__(self, kernel_size=2, stride=None, padding=0, pool_type="max",
+                 global_pooling=False, data_format="NHWC"):
+        super().__init__()
+        self.kw = dict(kernel=kernel_size, stride=stride, padding=padding,
+                       pool_type=pool_type, global_pooling=global_pooling,
+                       data_format=data_format)
+
+    def forward(self, params, x):
+        del params
+        return ops_nn.pool2d(x, **self.kw)
+
+
+class BatchNorm(Layer):
+    """BatchNorm with running stats in non-trainable params (fluid
+    batch_norm keeps moving mean/var as persistable non-trainable vars).
+    Training-mode stat updates flow through the state tape."""
+
+    def __init__(self, num_channels, epsilon=1e-5, momentum=0.9,
+                 data_format="NHWC"):
+        super().__init__()
+        self.scale = self.create_parameter("scale", (num_channels,),
+                                           initializer=I.ones)
+        self.bias = self.create_parameter("bias", (num_channels,),
+                                          initializer=I.zeros)
+        self.mean = self.create_parameter("mean", (num_channels,),
+                                          initializer=I.zeros, trainable=False)
+        self.variance = self.create_parameter("variance", (num_channels,),
+                                              initializer=I.ones, trainable=False)
+        self.epsilon, self.momentum = epsilon, momentum
+        self.data_format = data_format
+
+    def forward(self, params, x, training=False):
+        import jax
+
+        mean = jax.lax.stop_gradient(params["mean"])
+        var = jax.lax.stop_gradient(params["variance"])
+        out, new_mean, new_var = ops_nn.batch_norm(
+            x, params["scale"], params["bias"], mean, var,
+            epsilon=self.epsilon, momentum=self.momentum, training=training,
+            data_format=self.data_format)
+        if training:
+            report_state(self, {"mean": jax.lax.stop_gradient(new_mean),
+                                "variance": jax.lax.stop_gradient(new_var)})
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, scale=True, shift=True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.shape = tuple(normalized_shape)
+        self.has_scale, self.has_shift = scale, shift
+        n = math.prod(self.shape)
+        if scale:
+            self.scale = self.create_parameter("scale", (n,), initializer=I.ones)
+        if shift:
+            self.bias = self.create_parameter("bias", (n,), initializer=I.zeros)
+        self.epsilon = epsilon
+
+    def forward(self, params, x):
+        return ops_nn.layer_norm(
+            x, params["scale"] if self.has_scale else None,
+            params["bias"] if self.has_shift else None,
+            epsilon=self.epsilon, begin_norm_axis=x.ndim - len(self.shape))
+
+
+class Embedding(Layer):
+    """Token embedding (fluid lookup_table). Default sharding hint: rows
+    sharded over "tp" (vocab-parallel)."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 weight_init=None, sharding=P("tp", None)):
+        super().__init__()
+        self.weight = self.create_parameter(
+            "weight", (num_embeddings, embedding_dim),
+            initializer=weight_init or I.normal(0.0, 0.02), sharding=sharding)
+        self.padding_idx = padding_idx
+
+    def forward(self, params, ids):
+        return ops_nn.embedding(ids, params["weight"],
+                                padding_idx=self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, rate=0.5):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, params, x, key=None, training=False):
+        del params
+        if not training or key is None:
+            return x
+        return ops_nn.dropout(x, key, rate=self.rate, training=True)

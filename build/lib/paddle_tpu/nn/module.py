@@ -1,0 +1,347 @@
+"""Layer/Module system: functional parameters over an object-style API.
+
+Reference mapping: dygraph ``Layer`` (``python/paddle/fluid/dygraph/layers.py:31``,
+``__call__``:156) and the static-graph ``LayerHelper`` param-creation glue
+(``layer_helper.py:42``). TPU-native design differences:
+
+- Parameters live OUTSIDE the layer, in a nested-dict pytree, so the whole
+  model is a pure function ``(params, inputs) -> outputs`` that jit/pjit/grad
+  can transform. The Layer object holds only *specs* (shape/dtype/init/
+  sharding), fixed at construction time like fluid's size-taking dygraph
+  layers (Conv2D(num_channels, ...)).
+- Non-trainable running state (BatchNorm moving stats — fluid keeps them as
+  non-trainable Parameters) is updated through a trace-time state tape
+  (:func:`capture_state`), keeping forward functional under jit.
+- Per-parameter sharding hints (PartitionSpec) replace the multi-device
+  graph builder's placement decisions (``multi_devices_graph_pass.cc``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as init_mod
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Declaration of one parameter (fluid ParamAttr + VarDesc shape/dtype)."""
+
+    shape: Tuple[int, ...]
+    dtype: Any = jnp.float32
+    initializer: Callable = None
+    trainable: bool = True
+    # PartitionSpec naming mesh axes for GSPMD sharding (None = replicated
+    # unless a parallel plan overrides it).
+    sharding: Any = None
+
+    def initialize(self, key):
+        fn = self.initializer or init_mod.xavier_uniform()
+        return fn(key, tuple(self.shape), self.dtype)
+
+
+class Layer:
+    """Base class for all network modules."""
+
+    def __init__(self):
+        object.__setattr__(self, "_param_specs", {})
+        object.__setattr__(self, "_sublayers", {})
+        object.__setattr__(self, "_path", ())
+
+    # -- construction -----------------------------------------------------
+    def create_parameter(self, name: str, shape, dtype=jnp.float32,
+                         initializer: Optional[Callable] = None,
+                         trainable: bool = True, sharding=None) -> ParamSpec:
+        spec = ParamSpec(tuple(shape), dtype, initializer, trainable, sharding)
+        self._param_specs[name] = spec
+        return spec
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            self._sublayers[name] = value
+        elif isinstance(value, ParamSpec):
+            self._param_specs[name] = value
+        object.__setattr__(self, name, value)
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sublayers[name] = layer
+        object.__setattr__(self, name, layer)
+        return layer
+
+    # -- initialization ---------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        """Build the parameter pytree for this layer (recursively).
+
+        Key splitting is deterministic in the traversal order, which is fixed
+        by construction order — reproducible given a seed (parity with fluid's
+        per-program random seed).
+        """
+        # keep the path assigned by the parent (non-empty when this init is
+        # a recursive call); only the true root starts at ()
+        self._assign_paths(self._path)
+        params: Dict[str, Any] = {}
+        names = list(self._param_specs) + list(self._sublayers)
+        if names:
+            keys = jax.random.split(key, len(names))
+        for k, name in zip(keys if names else [], names):
+            if name in self._param_specs:
+                params[name] = self._param_specs[name].initialize(k)
+            else:
+                params[name] = self._sublayers[name].init(k)
+        return params
+
+    def _assign_paths(self, path):
+        object.__setattr__(self, "_path", tuple(path))
+        for name, sub in self._sublayers.items():
+            sub._assign_paths(tuple(path) + (name,))
+
+    # -- application ------------------------------------------------------
+    def __call__(self, params, *args, **kwargs):
+        return self.forward(params, *args, **kwargs)
+
+    def forward(self, params, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- introspection ----------------------------------------------------
+    def param_specs(self) -> Dict[Tuple[str, ...], ParamSpec]:
+        """Flat {path: spec} map over the whole tree."""
+        self._assign_paths(self._path)
+        out = {}
+        for name, spec in self._param_specs.items():
+            out[self._path + (name,)] = spec
+        for name, sub in self._sublayers.items():
+            out.update(sub.param_specs())
+        return out
+
+    def trainable_mask(self, params) -> Any:
+        """Pytree of bools matching ``params``: True where trainable."""
+        specs = {path: s.trainable for path, s in self.param_specs().items()}
+
+        def walk(tree, path):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (k,)) for k, v in tree.items()}
+            return specs.get(path, True)
+
+        return walk(params, ())
+
+    def sharding_specs(self, params) -> Any:
+        """Pytree of PartitionSpecs (None = replicated) matching ``params``."""
+        specs = {path: s.sharding for path, s in self.param_specs().items()}
+
+        def walk(tree, path):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (k,)) for k, v in tree.items()}
+            return specs.get(path)
+
+        return walk(params, ())
+
+    def sublayers(self):
+        return dict(self._sublayers)
+
+
+class StackedLayers(Layer):
+    """L structurally-identical layers stored as STACKED (L, ...) leaves —
+    the scan-over-layers layout.
+
+    TPU rationale: a transformer stack as L separate param subtrees makes
+    XLA compile L copies of the block and, under pipeline parallelism,
+    forces an in-graph stack + reshard every step. Stacked-from-init
+    leaves (a) scan-compile the block once, (b) carry a leading dim that
+    shards over "pp" natively (pipeline stages own their rows from
+    placement, no resharding), and (c) are what gpipe consumes directly.
+
+    The param tree has the TEMPLATE's structure with every leaf gaining a
+    leading (L,) dim; sharding hints get the stage axis prepended.
+    """
+
+    def __init__(self, template: "Layer", num_layers: int,
+                 stage_axis: str = "pp"):
+        super().__init__()
+        self.template = template
+        self.num_layers = num_layers
+        self.stage_axis = stage_axis
+
+    def init(self, key):
+        # local import: parallel.pipeline owns the one stacking idiom
+        # (module.py must stay importable before the parallel package)
+        from paddle_tpu.parallel.pipeline import stack_layer_params
+
+        self._assign_paths(self._path)
+        return stack_layer_params(
+            [self.template.init(k)
+             for k in jax.random.split(key, self.num_layers)])
+
+    def param_specs(self):
+        # template params live AT this module's path (no extra level);
+        # shapes gain (L,) and shardings the stage axis
+        self._assign_paths(self._path)
+        self.template._assign_paths(self._path)
+        out = {}
+        for path, spec in self.template.param_specs().items():
+            base = spec.sharding
+            if base is None:
+                sharding = jax.sharding.PartitionSpec(self.stage_axis)
+            else:
+                sharding = jax.sharding.PartitionSpec(self.stage_axis,
+                                                      *tuple(base))
+            out[path] = ParamSpec(
+                (self.num_layers,) + tuple(spec.shape), spec.dtype,
+                spec.initializer, spec.trainable, sharding)
+        return out
+
+    def forward(self, params, x, *, layer_keys=None, key=None, **kwargs):
+        """Sequential application via lax.scan (one compiled block).
+
+        Per-layer PRNG: pass stacked ``layer_keys`` (L keys), or a single
+        ``key`` which is split into L decorrelated per-layer keys (the
+        universal Layer ``key=`` convention — one key must never be
+        reused across layers or every layer draws identical dropout
+        masks)."""
+        if key is not None:
+            if layer_keys is not None:
+                raise ValueError("pass layer_keys OR key, not both")
+            layer_keys = jax.random.split(key, self.num_layers)
+
+        def body(h, xs):
+            lp, k = xs
+            return self.template(lp, h, key=k, **kwargs), None
+
+        if layer_keys is None:
+            def body_nokey(h, lp):
+                return self.template(lp, h, **kwargs), None
+
+            h, _ = jax.lax.scan(body_nokey, x, params)
+            return h
+        h, _ = jax.lax.scan(body, x, (params, layer_keys))
+        return h
+
+
+class LayerList(Layer):
+    """Indexable list of sublayers (fluid dygraph LayerList parity)."""
+
+    def __init__(self, layers=()):
+        super().__init__()
+        self._list = []
+        for layer in layers:
+            self.append(layer)
+
+    def append(self, layer: Layer):
+        name = str(len(self._list))
+        self._list.append(layer)
+        self.add_sublayer(name, layer)
+        return self
+
+    def __len__(self):
+        return len(self._list)
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def __getitem__(self, i):
+        return self._list[i]
+
+
+class Sequential(Layer):
+    """Chain of layers applied in order. Mode kwargs (training=..., key=...)
+    are forwarded only to sublayers whose forward accepts them."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        self.layers = LayerList(layers)
+
+    def forward(self, params, x, **kwargs):
+        import inspect
+
+        for i, layer in enumerate(self.layers):
+            if kwargs:
+                sig = inspect.signature(layer.forward)
+                accepted = {k: v for k, v in kwargs.items()
+                            if k in sig.parameters}
+            else:
+                accepted = {}
+            x = layer(params["layers"][str(i)], x, **accepted)
+        return x
+
+
+# -- state tape (BatchNorm running stats etc.) ----------------------------
+
+class _StateTape(threading.local):
+    def __init__(self):
+        self.active = None  # dict path->updates, or None
+
+
+_TAPE = _StateTape()
+
+
+class StateCapture:
+    def __init__(self):
+        self.updates: Dict[Tuple[str, ...], Any] = {}
+
+
+@contextlib.contextmanager
+def capture_state():
+    """Collect running-state updates emitted during a forward pass.
+
+    Usage (inside a loss function, traced under jit):
+        with capture_state() as tape:
+            logits = model(params, x, training=True)
+        new_params = apply_state_updates(params, tape)
+    """
+    prev = _TAPE.active
+    cap = StateCapture()
+    _TAPE.active = cap
+    try:
+        yield cap
+    finally:
+        _TAPE.active = prev
+
+
+def report_state(layer: Layer, updates: Dict[str, Any]):
+    """Called by layers (e.g. BatchNorm) to record new running stats."""
+    if _TAPE.active is None:
+        return
+    for name, val in updates.items():
+        _TAPE.active.updates[layer._path + (name,)] = val
+
+
+def apply_state_updates(params, cap):
+    """Merge tape updates back into the parameter tree (pure).
+    Accepts a StateCapture or its raw ``{path: value}`` dict.
+
+    Updates are cast to the dtype of the slot they replace: under an AMP
+    policy the forward computes running stats in the compute dtype
+    (bf16), but writing bf16 into an f32 state slot would flip the state
+    pytree's dtype after the first step — degrading the stats and, worse,
+    changing the jitted step's input signature (a full recompile on step
+    two, ~40s for ResNet-50).
+    """
+    if isinstance(cap, dict):
+        updates = cap
+        cap = StateCapture()
+        cap.updates = updates
+    if not cap.updates:
+        return params
+
+    def get_path(tree, path):
+        for p in path:
+            tree = tree[p]
+        return tree
+
+    def set_path(tree, path, value):
+        if len(path) == 1:
+            return {**tree, path[0]: value}
+        return {**tree, path[0]: set_path(tree[path[0]], path[1:], value)}
+
+    for path, val in cap.updates.items():
+        old = get_path(params, path)
+        if hasattr(old, "dtype") and hasattr(val, "astype") \
+                and val.dtype != old.dtype:
+            val = val.astype(old.dtype)
+        params = set_path(params, path, val)
+    return params

@@ -1,0 +1,218 @@
+"""Recurrent layers: LSTM/GRU/vanilla RNN cells + scan-based unrolling.
+
+Reference mapping: ``operators/lstm_op``, ``gru_op``, ``cudnn_lstm_op``,
+``recurrent_op`` (sub-block interpreter loop) and the Python ``DynamicRNN``
+(``layers/control_flow.py``) over LoD ragged batches. TPU-native: cells are
+pure step functions unrolled with ``lax.scan`` (XLA pipelines the time
+loop); ragged sequences use a (B,) lengths vector with masked state
+carry-through instead of LoD — positions past a row's length keep the last
+valid hidden state, matching sequence-last semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import Layer
+
+
+class LSTMCell(Layer):
+    """Fused-gate LSTM cell (i,f,g,o in one matmul — MXU-friendly,
+    ≙ math/lstm_compute fused gate kernels)."""
+
+    def __init__(self, input_size, hidden_size, forget_bias=1.0):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.w = self.create_parameter(
+            "w", (input_size + hidden_size, 4 * hidden_size),
+            initializer=I.xavier_uniform(), sharding=P(None, "tp"))
+        self.b = self.create_parameter("b", (4 * hidden_size,),
+                                       initializer=I.zeros)
+        self.forget_bias = forget_bias
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+    def forward(self, params, state, x):
+        h, c = state
+        gates = jnp.concatenate([x, h], -1) @ params["w"] + params["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + self.forget_bias) * c \
+            + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class LSTMPCell(Layer):
+    """LSTM with a recurrent projection (dynamic_lstmp_op): cell state is
+    ``hidden_size`` wide but the recurrent/output state is projected down
+    to ``proj_size`` — the large-vocab speech/LM configuration."""
+
+    def __init__(self, input_size, hidden_size, proj_size,
+                 forget_bias=1.0):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.proj_size = proj_size
+        self.w = self.create_parameter(
+            "w", (input_size + proj_size, 4 * hidden_size),
+            initializer=I.xavier_uniform(), sharding=P(None, "tp"))
+        self.b = self.create_parameter("b", (4 * hidden_size,),
+                                       initializer=I.zeros)
+        self.proj = self.create_parameter(
+            "proj", (hidden_size, proj_size),
+            initializer=I.xavier_uniform(), sharding=P("tp", None))
+        self.forget_bias = forget_bias
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.proj_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def forward(self, params, state, x):
+        r, c = state
+        gates = jnp.concatenate([x, r], -1) @ params["w"] + params["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + self.forget_bias) * c \
+            + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        r = h @ params["proj"]
+        return (r, c), r
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.w_rz = self.create_parameter(
+            "w_rz", (input_size + hidden_size, 2 * hidden_size),
+            initializer=I.xavier_uniform(), sharding=P(None, "tp"))
+        self.w_h = self.create_parameter(
+            "w_h", (input_size + hidden_size, hidden_size),
+            initializer=I.xavier_uniform(), sharding=P(None, "tp"))
+        self.b_rz = self.create_parameter("b_rz", (2 * hidden_size,),
+                                          initializer=I.zeros)
+        self.b_h = self.create_parameter("b_h", (hidden_size,),
+                                         initializer=I.zeros)
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def forward(self, params, state, x):
+        h = state
+        rz = jax.nn.sigmoid(jnp.concatenate([x, h], -1) @ params["w_rz"]
+                            + params["b_rz"])
+        r, z = jnp.split(rz, 2, axis=-1)
+        hh = jnp.tanh(jnp.concatenate([x, r * h], -1) @ params["w_h"]
+                      + params["b_h"])
+        h = (1 - z) * hh + z * h
+        return h, h
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation=jnp.tanh):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.w = self.create_parameter(
+            "w", (input_size + hidden_size, hidden_size),
+            initializer=I.xavier_uniform())
+        self.b = self.create_parameter("b", (hidden_size,),
+                                       initializer=I.zeros)
+        self.activation = activation
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def forward(self, params, state, x):
+        h = self.activation(jnp.concatenate([x, state], -1) @ params["w"]
+                            + params["b"])
+        return h, h
+
+
+class RNN(Layer):
+    """Unroll a cell over time with lax.scan (recurrent_op / DynamicRNN).
+
+    forward(params, x, lengths=None, initial_state=None, reverse=False)
+      x: (B, T, D). Returns (outputs (B,T,H), final_state).
+    ``lengths``: (B,) — positions >= length are masked: outputs zeroed,
+    state frozen at the last valid step (LoD ragged parity).
+    """
+
+    def __init__(self, cell: Layer, reverse: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.reverse = reverse
+
+    def forward(self, params, x, lengths=None, initial_state=None):
+        b, t, _ = x.shape
+        state = (initial_state if initial_state is not None
+                 else self.cell.initial_state(b, x.dtype))
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, D)
+        if self.reverse:
+            xs = xs[::-1]
+        steps = jnp.arange(t)
+        if self.reverse:
+            steps = steps[::-1]
+
+        def scan_fn(state, inp):
+            step_x, step_i = inp
+            new_state, out = self.cell(params["cell"], state, step_x)
+            if lengths is not None:
+                valid = (step_i < lengths)[:, None]
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(valid, n, o), new_state, state)
+                out = jnp.where(valid, out, 0.0)
+            return new_state, out
+
+        final, outs = jax.lax.scan(scan_fn, state, (xs, steps))
+        outs = jnp.swapaxes(outs, 0, 1)
+        if self.reverse:
+            outs = outs[:, ::-1]
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper: concat of forward and backward passes."""
+
+    def __init__(self, fwd_cell: Layer, bwd_cell: Layer):
+        super().__init__()
+        self.fwd = RNN(fwd_cell)
+        self.bwd = RNN(bwd_cell, reverse=True)
+
+    def forward(self, params, x, lengths=None):
+        of, sf = self.fwd(params["fwd"], x, lengths)
+        ob, sb = self.bwd(params["bwd"], x, lengths)
+        return jnp.concatenate([of, ob], -1), (sf, sb)
+
+
+class LSTM(Layer):
+    """Multi-layer (optionally bidirectional) LSTM — cudnn_lstm_op parity."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 bidirectional=False):
+        super().__init__()
+        from paddle_tpu.nn.module import LayerList
+
+        size = input_size
+        layers = []
+        for _ in range(num_layers):
+            if bidirectional:
+                layers.append(BiRNN(LSTMCell(size, hidden_size),
+                                    LSTMCell(size, hidden_size)))
+                size = 2 * hidden_size
+            else:
+                layers.append(RNN(LSTMCell(size, hidden_size)))
+                size = hidden_size
+        self.stack = LayerList(layers)
+        self.output_size = size
+
+    def forward(self, params, x, lengths=None):
+        finals = []
+        for i, layer in enumerate(self.stack):
+            x, final = layer(params["stack"][str(i)], x, lengths)
+            finals.append(final)
+        return x, finals

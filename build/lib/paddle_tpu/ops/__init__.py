@@ -1,0 +1,30 @@
+"""Functional op library (the L2 operator surface, TPU-native).
+
+Importing this package registers every op into the OpInfoMap
+(paddle_tpu.core.registry), mirroring the reference's static-registrar
+pattern (op_registry.h:199) without global constructors.
+"""
+
+from paddle_tpu.ops import (activation, attention, crf, detection,
+                            elementwise, math, metrics_ops, niche, nn,
+                            reduction, sequence, tensor)
+from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
+                                      flash_attention,
+                                      scaled_dot_product_attention)
+from paddle_tpu.ops.activation import *  # noqa: F401,F403
+from paddle_tpu.ops.elementwise import add, div, max, min, mod, mul as multiply, pow as elementwise_pow, sub  # noqa: F401
+from paddle_tpu.ops.math import bmm, dot, fc, matmul, mul  # noqa: F401
+from paddle_tpu.ops.nn import (batch_norm, conv2d, conv2d_transpose,  # noqa: F401
+                               cross_entropy, depthwise_conv2d, dropout,
+                               embedding, interpolate, label_smooth,
+                               layer_norm, log_softmax, one_hot, pool2d,
+                               sigmoid_cross_entropy_with_logits, softmax,
+                               softmax_with_cross_entropy, square_error_cost)
+from paddle_tpu.ops.reduction import (logsumexp, mean, reduce_all, reduce_any,  # noqa: F401
+                                      reduce_max, reduce_mean, reduce_min,
+                                      reduce_prod, reduce_sum)
+from paddle_tpu.ops.tensor import (accuracy, argmax, argmin, argsort, assign,  # noqa: F401
+                                   cast, concat, expand, fill_constant,
+                                   flatten, gather, gather_nd,
+                                   reshape, scatter, slice, split, squeeze,
+                                   stack, top_k, transpose, unsqueeze, where)

@@ -1,0 +1,170 @@
+"""Activation ops (reference: ``paddle/fluid/operators/activation_op.*`` —
+~30 activations with hand-written CUDA grads; here XLA differentiates)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+__all__ = [
+    "relu", "sigmoid", "tanh", "gelu", "softplus", "softsign", "exp", "log",
+    "square", "sqrt", "rsqrt", "abs", "ceil", "floor", "round", "reciprocal",
+    "sin", "cos", "swish", "silu", "leaky_relu", "elu", "relu6",
+    "hard_sigmoid", "hard_swish", "prelu", "pow", "clip",
+    "selu", "mish", "softshrink", "hard_shrink", "tanh_shrink",
+    "thresholded_relu", "logsigmoid", "stanh",
+]
+
+
+def _reg(name, fn, np_ref):
+    register_op(name, reference=np_ref)(fn)
+    return fn
+
+
+relu = _reg("relu", jax.nn.relu, lambda x: np.maximum(x, 0))
+sigmoid = _reg("sigmoid", jax.nn.sigmoid, lambda x: 1 / (1 + np.exp(-x)))
+tanh = _reg("tanh", jnp.tanh, np.tanh)
+gelu = _reg("gelu", jax.nn.gelu,
+            lambda x: 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi) * (x + 0.044715 * x**3))))
+softplus = _reg("softplus", jax.nn.softplus, lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0))
+softsign = _reg("softsign", jax.nn.soft_sign, lambda x: x / (1 + np.abs(x)))
+exp = _reg("exp", jnp.exp, np.exp)
+log = _reg("log", jnp.log, np.log)
+square = _reg("square", jnp.square, np.square)
+sqrt = _reg("sqrt", jnp.sqrt, np.sqrt)
+rsqrt = _reg("rsqrt", jax.lax.rsqrt, lambda x: 1 / np.sqrt(x))
+abs = _reg("abs", jnp.abs, np.abs)
+ceil = _reg("ceil", jnp.ceil, np.ceil)
+floor = _reg("floor", jnp.floor, np.floor)
+round = _reg("round", jnp.round, np.round)
+reciprocal = _reg("reciprocal", jnp.reciprocal, lambda x: 1 / x)
+sin = _reg("sin", jnp.sin, np.sin)
+cos = _reg("cos", jnp.cos, np.cos)
+swish = _reg("swish", jax.nn.silu, lambda x: x / (1 + np.exp(-x)))
+silu = swish
+
+
+@register_op("leaky_relu", reference=lambda x, alpha=0.02: np.where(x >= 0, x, alpha * x))
+def leaky_relu(x, alpha=0.02):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@register_op("elu", reference=lambda x, alpha=1.0: np.where(x > 0, x, alpha * (np.exp(x) - 1)))
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register_op("relu6", reference=lambda x: np.minimum(np.maximum(x, 0), 6))
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register_op("hard_sigmoid", reference=lambda x, slope=0.2, offset=0.5:
+             np.clip(slope * x + offset, 0, 1))
+def hard_sigmoid(x, slope=0.2, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op("hard_swish", reference=lambda x: x * np.clip(x + 3, 0, 6) / 6)
+def hard_swish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_op("prelu", reference=lambda x, alpha: np.where(x >= 0, x, alpha * x))
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("pow", reference=lambda x, factor=1.0: np.power(x, factor))
+def pow(x, factor=1.0):
+    return jnp.power(x, factor)
+
+
+@register_op("clip", reference=lambda x, min, max: np.clip(x, min, max))
+def clip(x, min, max):  # noqa: A002 - fluid op signature
+    return jnp.clip(x, min, max)
+
+
+# -- activation long tail (activation_op.cc breadth) ------------------------
+
+@register_op("selu", reference=lambda x, scale=1.0507009873554805,
+             alpha=1.6732632423543772:
+             scale * np.where(x > 0, x, alpha * (np.exp(x) - 1)))
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register_op("mish", reference=lambda x:
+             x * np.tanh(np.log1p(np.exp(x))))
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("softshrink", reference=lambda x, lambda_=0.5:
+             np.where(x > lambda_, x - lambda_,
+                      np.where(x < -lambda_, x + lambda_, 0.0)))
+def softshrink(x, lambda_=0.5):
+    return jnp.where(x > lambda_, x - lambda_,
+                     jnp.where(x < -lambda_, x + lambda_, 0.0))
+
+
+@register_op("hard_shrink", reference=lambda x, threshold=0.5:
+             np.where(np.abs(x) > threshold, x, 0.0))
+def hard_shrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("tanh_shrink", reference=lambda x: x - np.tanh(x))
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("thresholded_relu", reference=lambda x, threshold=1.0:
+             np.where(x > threshold, x, 0.0))
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@register_op("logsigmoid", reference=lambda x:
+             -np.log1p(np.exp(-np.abs(x))) + np.minimum(x, 0))
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("stanh", reference=lambda x, scale_a=0.67, scale_b=1.7159:
+             scale_b * np.tanh(scale_a * x))
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("acos", reference=np.arccos)
+def acos(x):
+    """acos activation (activation_op.cc AcosFunctor)."""
+    return jnp.arccos(x)
+
+
+@register_op("asin", reference=np.arcsin)
+def asin(x):
+    """asin activation."""
+    return jnp.arcsin(x)
+
+
+@register_op("atan", reference=np.arctan)
+def atan(x):
+    """atan activation."""
+    return jnp.arctan(x)
+
+
+@register_op("brelu", reference=None)
+def brelu(x, t_min=0.0, t_max=24.0):
+    """brelu: clip(x, t_min, t_max) (activation_op.cc BReluFunctor)."""
+    return jnp.clip(x, t_min, t_max)
+
+
+@register_op("soft_relu", reference=None)
+def soft_relu(x, threshold=40.0):
+    """soft_relu: log(1 + exp(clip(x, -t, t)))."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))

@@ -1,0 +1,123 @@
+"""Control-flow ops: traced loops/branches with the reference's API names.
+
+Reference mapping: ``operators/controlflow/`` — ``while_op.cc`` (runs a
+sub-block via a nested Executor), ``conditional_block_op.cc``, compare ops,
+tensor-array read/write — and the Python builders ``layers/control_flow.py``
+(While, IfElse, Switch, StaticRNN). TPU-native: sub-blocks are traced
+closures; XLA compiles ``lax.while_loop``/``cond``/``scan`` natively, so
+the interpreter-in-interpreter machinery disappears. TensorArray maps to a
+pre-allocated array + dynamic_update_slice (static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("while_loop")
+def while_loop(cond: Callable, body: Callable, init: Any):
+    """``while cond(x): x = body(x)`` (while_op.cc parity)."""
+    return jax.lax.while_loop(cond, body, init)
+
+
+@register_op("cond")
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """conditional_block_op parity (both branches traced, one executed)."""
+    return jax.lax.cond(pred, true_fn, false_fn, *operands)
+
+
+@register_op("case")
+def case(index, branches: Sequence[Callable], *operands):
+    """layers.Switch parity: select branch by integer index."""
+    return jax.lax.switch(index, list(branches), *operands)
+
+
+@register_op("scan")
+def scan(fn: Callable, init: Any, xs: Any, *, length=None, reverse=False):
+    """StaticRNN / DynamicRNN-over-time parity: carry + stacked outputs."""
+    return jax.lax.scan(fn, init, xs, length=length, reverse=reverse)
+
+
+@register_op("fori_loop")
+def fori_loop(lower, upper, body: Callable, init: Any):
+    return jax.lax.fori_loop(lower, upper, body, init)
+
+
+class TensorArray:
+    """Write-once tensor array (lod_tensor_array / tensor_array_read_write
+    ops) on static shapes: preallocated (size, *elem_shape) buffer."""
+
+    def __init__(self, size: int, elem_shape, dtype=jnp.float32,
+                 buffer=None):
+        self.size = size
+        self._buf = (buffer if buffer is not None
+                     else jnp.zeros((size,) + tuple(elem_shape), dtype))
+
+    def write(self, i, value) -> "TensorArray":
+        return TensorArray(self.size, value.shape, value.dtype,
+                           jax.lax.dynamic_update_index_in_dim(
+                               self._buf, value, i, 0))
+
+    def read(self, i):
+        return jax.lax.dynamic_index_in_dim(self._buf, i, keepdims=False)
+
+    def stack(self):
+        return self._buf
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda ta: ((ta._buf,), ta.size),
+    lambda size, bufs: TensorArray(size, bufs[0].shape[1:], bufs[0].dtype,
+                                   bufs[0]),
+)
+
+
+# --- fluid array-layer aliases over TensorArray (layers.create_array,
+# array_read/array_write/array_length, tensor_array_to_tensor) ------------
+
+def create_array(size, example):
+    """layers.create_array: a TensorArray of ``size`` slots shaped like
+    ``example``."""
+    return TensorArray(size, example.shape, example.dtype)
+
+
+def array_write(arr, i, x):
+    """layers.array_write (functional: returns the new array)."""
+    return arr.write(i, x)
+
+
+def array_read(arr, i):
+    """layers.array_read."""
+    return arr.read(i)
+
+
+def array_length(arr):
+    """layers.array_length."""
+    return arr.size
+
+
+def tensor_array_to_tensor(arr, axis=0):
+    """tensor_array_to_tensor_op: stack (axis=0 insert) or concat along
+    an existing axis."""
+    import jax.numpy as jnp
+    stacked = arr.stack()
+    if axis == 0:
+        return stacked
+    parts = [jax.lax.index_in_dim(stacked, i, 0, keepdims=False)
+             for i in range(stacked.shape[0])]
+    return jnp.concatenate(parts, axis=axis - 1)
+
+
+def py_func(fn, args, out_shape_dtype):
+    """layers.py_func (py_func_op): run a host-side Python function inside
+    a traced program. TPU-native form: ``jax.pure_callback`` — the host
+    function must be pure (the reference documents the same requirement);
+    ``out_shape_dtype`` is a pytree of jax.ShapeDtypeStruct (static shapes,
+    as XLA requires)."""
+    return jax.pure_callback(fn, out_shape_dtype, *args)

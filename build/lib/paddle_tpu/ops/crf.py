@@ -1,0 +1,209 @@
+"""Linear-chain CRF + CTC — the structured-prediction tail of the
+reference op library.
+
+Reference mapping:
+- ``operators/linear_chain_crf_op.cc`` (forward-algorithm negative
+  log-likelihood; the reference hand-codes the gradient, here autodiff
+  differentiates the log-partition scan).
+- ``operators/crf_decoding_op.cc`` (Viterbi decode).
+- ``operators/warpctc_op.cc`` (CTC loss via the external warp-ctc library;
+  here optax's native XLA ctc_loss).
+
+TPU design: batches are padded (B, T, N) with per-row lengths — the LoD
+analog — and both the forward pass and Viterbi are ``lax.scan``s over
+time, masked past each row's length, so one compiled program serves every
+bucket shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _scan_log_alpha(emission, transition, length):
+    """log-alpha recursion for one row: emission (T, N), transition
+    (N, N) [from, to]. Returns logZ (scalar, masked at ``length``)."""
+    t_len, n = emission.shape
+
+    def step(alpha, inp):
+        emit, t = inp
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i, j]) + emit[j]
+        nxt = jax.nn.logsumexp(alpha[:, None] + transition, axis=0) + emit
+        alpha = jnp.where(t < length, nxt, alpha)
+        return alpha, None
+
+    alpha0 = emission[0]
+    alpha, _ = jax.lax.scan(
+        step, alpha0, (emission[1:], jnp.arange(1, t_len)))
+    return jax.nn.logsumexp(alpha)
+
+
+def _gold_score(emission, label, transition, length):
+    t_len = emission.shape[0]
+    idx = jnp.arange(t_len)
+    emit = jnp.take_along_axis(emission, label[:, None], -1)[:, 0]
+    emit = jnp.where(idx < length, emit, 0.0).sum()
+    trans = transition[label[:-1], label[1:]]
+    trans = jnp.where(idx[1:] < length, trans, 0.0).sum()
+    return emit + trans
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(emission, label, length, transition, *,
+                     start=None, stop=None):
+    """Per-sequence negative log-likelihood (linear_chain_crf_op).
+    ``emission`` (B, T, N) unary scores; ``label`` (B, T) int gold tags;
+    ``length`` (B,) valid steps per row; ``transition`` (N, N) [from, to];
+    optional ``start``/``stop`` (N,) boundary scores (the reference packs
+    them as the two extra rows of its (N+2, N) transition tensor).
+    Returns (B,) NLL; gradients flow to emission/transition/start/stop via
+    autodiff (≙ the hand-written grad kernel)."""
+    n = emission.shape[-1]
+    if start is not None:
+        emission = emission.at[:, 0, :].add(start[None, :])
+    if stop is not None:
+        # add stop score at each row's last valid step
+        last = jnp.maximum(length - 1, 0)
+        emission = emission + (
+            (jnp.arange(emission.shape[1])[None, :, None]
+             == last[:, None, None]) * stop[None, None, :])
+
+    def one(em, lab, ln):
+        logz = _scan_log_alpha(em, transition, ln)
+        gold = _gold_score(em, lab, transition, ln)
+        return logz - gold
+
+    return jax.vmap(one)(emission, label, length)
+
+
+@register_op("crf_decoding")
+def crf_decoding(emission, transition, length, *, start=None, stop=None,
+                 label=None):
+    """Viterbi decode (crf_decoding_op). Same layouts as
+    :func:`linear_chain_crf`. Returns (B, T) best paths (entries past
+    ``length`` are 0). With ``label`` given, returns instead a (B, T)
+    0/1 correctness mask like the reference (crf_decoding_op.h:70,99:
+    1 where decoded == label, 0 elsewhere and past length)."""
+    b, t_len, n = emission.shape
+    if start is not None:
+        emission = emission.at[:, 0, :].add(start[None, :])
+    if stop is not None:
+        last = jnp.maximum(length - 1, 0)
+        emission = emission + (
+            (jnp.arange(t_len)[None, :, None]
+             == last[:, None, None]) * stop[None, None, :])
+
+    def one(em, ln):
+        def fwd(carry, inp):
+            score, t = carry, inp[0]
+            emit = inp[1]
+            cand = score[:, None] + transition           # (from, to)
+            best_prev = jnp.argmax(cand, axis=0)         # (N,)
+            nxt = cand.max(axis=0) + emit
+            keep = t < ln
+            score = jnp.where(keep, nxt, score)
+            ptr = jnp.where(keep, best_prev,
+                            jnp.arange(n))               # identity ptr
+            return score, ptr
+
+        score, ptrs = jax.lax.scan(
+            fwd, em[0], (jnp.arange(1, t_len), em[1:]))
+        last_tag = jnp.argmax(score)
+
+        def back(tag, ptr):
+            prev = ptr[tag]
+            return prev, tag
+
+        # reverse scan emits tag_{t} at index t-1 and finishes carrying
+        # tag_0: prepend it (NOT append last_tag — it is already emitted)
+        tag0, path = jax.lax.scan(back, last_tag, ptrs, reverse=True)
+        path = jnp.concatenate([tag0[None], path])
+        return jnp.where(jnp.arange(t_len) < ln, path, 0)
+
+    paths = jax.vmap(one)(emission, length)
+    if label is not None:
+        correct = (paths == label) & (
+            jnp.arange(t_len)[None, :] < length[:, None])
+        return correct.astype(jnp.int32)
+    return paths
+
+
+@register_op("warpctc")
+def ctc_loss(logits, logit_lengths, labels, label_lengths, *, blank=0):
+    """CTC loss (warpctc_op semantics, XLA-native via optax).
+    ``logits`` (B, T, V) unnormalized; ``labels`` (B, L) int padded.
+    Returns (B,) per-sequence loss."""
+    import optax
+
+    b, t_len, _ = logits.shape
+    logitpad = (jnp.arange(t_len)[None, :]
+                >= logit_lengths[:, None]).astype(jnp.float32)
+    labelpad = (jnp.arange(labels.shape[1])[None, :]
+                >= label_lengths[:, None]).astype(jnp.float32)
+    return optax.ctc_loss(logits, logitpad, labels, labelpad,
+                          blank_id=blank)
+
+
+@register_op("ctc_greedy_decoder", has_grad=False)
+def ctc_greedy_decoder(probs, lengths, *, blank=0):
+    """layers.ctc_greedy_decoder (ctc_align_op): per-frame argmax, merge
+    repeats, drop blanks. Static shapes: returns (tokens (B, T) padded
+    with ``blank``, out_lengths (B,))."""
+    b, t, v = probs.shape
+    ids = jnp.argmax(probs, -1)                               # (B, T)
+    frame_valid = jnp.arange(t)[None, :] < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1), ids[:, :-1]], 1)
+    keep = (ids != blank) & (ids != prev) & frame_valid
+
+    def compact(row_ids, row_keep):
+        # stable order: kept tokens first (argsort of ~keep is stable)
+        order = jnp.argsort(~row_keep)
+        out = jnp.where(row_keep[order], row_ids[order], blank)
+        return out
+
+    tokens = jax.vmap(compact)(ids, keep)
+    return tokens, keep.sum(-1)
+
+
+@register_op("edit_distance", has_grad=False)
+def edit_distance(hyp, hyp_lengths, ref, ref_lengths, *,
+                  normalized=True):
+    """edit_distance_op: in-graph Levenshtein DP between padded int
+    sequences — (B, L1), (B, L2) with per-row lengths. The DP runs as a
+    scan over hypothesis tokens carrying one (L2+1) row (static shapes);
+    padded positions are neutralized by clamping to the row lengths."""
+    l2 = ref.shape[1]
+
+    def one(h_row, h_len, r_row, r_len):
+        init = jnp.arange(l2 + 1, dtype=jnp.float32)
+        init = jnp.minimum(init, r_len.astype(jnp.float32))
+
+        def step(prev, inp):
+            tok, i = inp
+            active = i < h_len
+
+            def row_fn(carry, j):
+                diag, left = carry
+                up = prev[j + 1]
+                sub = diag + (tok != r_row[j])
+                best = jnp.minimum(jnp.minimum(up + 1, left + 1), sub)
+                best = jnp.where(j < r_len, best, left)  # clamp at r_len
+                return (up, best), best
+
+            first = prev[0] + 1.0
+            (_, _), rest = jax.lax.scan(row_fn, (prev[0], first),
+                                        jnp.arange(l2))
+            cur = jnp.concatenate([first[None], rest])
+            return jnp.where(active, cur, prev), None
+
+        final, _ = jax.lax.scan(
+            step, init, (h_row, jnp.arange(h_row.shape[0])))
+        d = final[jnp.minimum(r_len, l2)]
+        if normalized:
+            d = d / jnp.maximum(r_len, 1)
+        return d
+
+    return jax.vmap(one)(hyp, hyp_lengths, ref, ref_lengths)

@@ -1,0 +1,89 @@
+"""Broadcasted elementwise binary ops.
+
+Reference: ``paddle/fluid/operators/elementwise/`` (34 files, hand-rolled
+broadcast engine in ``elementwise_op_function.h``). On TPU the entire
+broadcast machinery is XLA's — these are thin registrations so the op
+surface, OpTest coverage, and ``axis``-style broadcasting parity exist.
+
+Fluid's ``axis`` attribute aligns y's dims starting at ``axis`` of x
+(e.g. x:[N,C,H,W], y:[C], axis=1). We reproduce that by reshaping y.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _align(x, y, axis):
+    """Expand y to x's rank with fluid's axis semantics."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    trailing = x.ndim - axis - y.ndim
+    if trailing < 0:
+        raise ValueError(f"bad axis {axis} for shapes {x.shape}, {y.shape}")
+    return y.reshape(y.shape + (1,) * trailing)
+
+
+def _np_align(x, y, axis):
+    x, y = np.asarray(x), np.asarray(y)
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    return y.reshape(y.shape + (1,) * (x.ndim - axis - y.ndim))
+
+
+def _make(name, fn, np_fn):
+    def ref(x, y, axis=-1):
+        return np_fn(x, _np_align(x, y, axis))
+
+    @register_op(f"elementwise_{name}", reference=ref)
+    def op(x, y, axis=-1):
+        return fn(x, _align(x, jnp.asarray(y), axis))
+
+    op.__name__ = f"elementwise_{name}"
+    op.__doc__ = f"Broadcasted elementwise {name} (fluid elementwise_{name}_op)."
+    return op
+
+
+add = _make("add", jnp.add, np.add)
+sub = _make("sub", jnp.subtract, np.subtract)
+mul = _make("mul", jnp.multiply, np.multiply)
+div = _make("div", jnp.divide, np.divide)
+floordiv = _make("floordiv", jnp.floor_divide, np.floor_divide)
+mod = _make("mod", jnp.mod, np.mod)
+max = _make("max", jnp.maximum, np.maximum)
+min = _make("min", jnp.minimum, np.minimum)
+pow = _make("pow", jnp.power, np.power)
+
+
+# ---------------------------------------------------------------------------
+# comparison + logical ops (operators/controlflow/compare_op.cc,
+# logical_op.cc — fluid surfaces them as layers.equal/less_than/...)
+# ---------------------------------------------------------------------------
+
+def _cmp(name, jfn, nfn):
+    @register_op(name, reference=nfn, has_grad=False)
+    def op(x, y, axis=-1):
+        return jfn(x, _align(x, y, axis))
+    op.__name__ = name
+    op.__doc__ = f"{name}_op: elementwise comparison, bool output."
+    return op
+
+
+equal = _cmp("equal", jnp.equal, np.equal)
+not_equal = _cmp("not_equal", jnp.not_equal, np.not_equal)
+less_than = _cmp("less_than", jnp.less, np.less)
+less_equal = _cmp("less_equal", jnp.less_equal, np.less_equal)
+greater_than = _cmp("greater_than", jnp.greater, np.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal, np.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and, np.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or, np.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor, np.logical_xor)
+
+
+@register_op("logical_not", reference=np.logical_not, has_grad=False)
+def logical_not(x):
+    """logical_not_op."""
+    return jnp.logical_not(x)

@@ -1,0 +1,110 @@
+"""Linear-algebra ops: the MXU surface.
+
+Reference: ``operators/mul_op.*``, ``matmul_op.*``, and the Blas wrapper
+library (``operators/math/blas.h:81,226`` — MKL/cuBLAS incl. batched gemm).
+On TPU all of these lower to a single XLA ``dot_general`` that the compiler
+tiles onto the 128x128 MXU; batched/strided gemm variants disappear.
+
+bf16 policy note: matmuls accept a ``precision``/dtype hint; by default we
+let the AMP policy (paddle_tpu.amp) cast inputs and keep accumulation f32
+(XLA default for bf16 dots on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _np_mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    xm = np.reshape(x, (int(np.prod(x.shape[:x_num_col_dims])), -1))
+    ym = np.reshape(y, (int(np.prod(y.shape[:y_num_col_dims])), -1))
+    out = xm @ ym
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])
+
+
+@register_op("mul", reference=_np_mul)
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """Flatten-then-matmul (fluid mul_op: operators/mul_op.cc)."""
+    xm = x.reshape((int(np.prod(x.shape[:x_num_col_dims])), -1))
+    ym = y.reshape((int(np.prod(y.shape[:y_num_col_dims])), -1))
+    out = jnp.dot(xm, ym)
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])
+
+
+def _np_matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0):
+    if transpose_x:
+        x = np.swapaxes(x, -1, -2) if np.ndim(x) > 1 else x
+    if transpose_y:
+        y = np.swapaxes(y, -1, -2) if np.ndim(y) > 1 else y
+    return alpha * np.matmul(x, y)
+
+
+@register_op("matmul", reference=_np_matmul)
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0):
+    """Batched matmul (fluid matmul_op; cuBLAS strided-batch -> one XLA dot)."""
+    if transpose_x and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+@register_op("dot", reference=lambda x, y: np.sum(x * y, -1, keepdims=True))
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+@register_op("bmm", reference=np.matmul)
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def _np_fc(x, w, b=None, num_flatten_dims=1):
+    out = _np_mul(x, w, num_flatten_dims, 1)
+    if b is not None:
+        out = out + b
+    return out
+
+
+@register_op("fc", reference=_np_fc)
+def fc(x, w, b=None, num_flatten_dims=1):
+    """Fully-connected: mul + bias add, the target of fluid's fc_fuse_pass
+    (``ir/fc_fuse_pass.cc``). XLA fuses the bias add into the dot epilogue."""
+    out = mul(x, w, num_flatten_dims, 1)
+    if b is not None:
+        out = out + b
+    return out
+
+
+@register_op("addmm", reference=lambda inp, x, y, alpha=1.0, beta=1.0:
+             beta * inp + alpha * (x @ y))
+def addmm(input, x, y, alpha=1.0, beta=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("cholesky", reference=np.linalg.cholesky)
+def cholesky(x):
+    return jnp.linalg.cholesky(x)
+
+
+@register_op("norm", reference=lambda x, axis=-1, epsilon=1e-10:
+             x / np.sqrt(np.sum(np.square(x), axis, keepdims=True) + epsilon))
+def l2_normalize(x, axis=-1, epsilon=1e-10):
+    return x * jax.lax.rsqrt(jnp.sum(jnp.square(x), axis, keepdims=True) + epsilon)
+
+
+@register_op("cumsum", reference=lambda x, axis=-1: np.cumsum(x, axis))
+def cumsum(x, axis=-1):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_op("einsum", reference=np.einsum)
+def einsum(subscripts, *operands):
+    return jnp.einsum(subscripts, *operands)

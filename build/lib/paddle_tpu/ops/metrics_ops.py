@@ -1,0 +1,51 @@
+"""In-graph metric ops (``operators/metrics/``: auc_op.cc,
+precision_recall_op.cc; accuracy lives in ops/tensor.py). The host-side
+streaming classes in ``paddle_tpu.metrics`` wrap these for eval loops;
+the in-graph forms fuse into jitted eval steps and carry their stat
+buffers functionally (the reference mutates persistable stat tensors —
+here the updated buffers are returned)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("auc", has_grad=False)
+def auc(probs, labels, pos_bins, neg_bins):
+    """auc_op: binned ROC-AUC. ``probs`` (N,) positive-class scores in
+    [0, 1]; ``labels`` (N,) {0,1}; ``pos_bins``/``neg_bins`` (K+1,)
+    running histograms. Returns (auc, new_pos_bins, new_neg_bins)."""
+    k = pos_bins.shape[0] - 1
+    idx = jnp.clip((probs * k).astype(jnp.int32), 0, k)
+    pos = labels > 0.5
+    pos_bins = pos_bins.at[idx].add(pos.astype(pos_bins.dtype))
+    neg_bins = neg_bins.at[idx].add((~pos).astype(neg_bins.dtype))
+    # threshold sweep high->low, trapezoid rule
+    tp = jnp.cumsum(pos_bins[::-1])
+    fp = jnp.cumsum(neg_bins[::-1])
+    tot_p = jnp.maximum(tp[-1], 1e-12)
+    tot_n = jnp.maximum(fp[-1], 1e-12)
+    tpr = tp / tot_p
+    fpr = fp / tot_n
+    area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) * 0.5)
+    area = area + fpr[0] * tpr[0] * 0.5          # first trapezoid from 0
+    # single-class history is "no information" — 0.5, like metrics.Auc
+    degenerate = (pos_bins.sum() == 0) | (neg_bins.sum() == 0)
+    return jnp.where(degenerate, 0.5, area), pos_bins, neg_bins
+
+
+@register_op("precision_recall", has_grad=False)
+def precision_recall(probs, labels, stats, threshold=0.5):
+    """precision_recall_op (binary): ``stats`` = (tp, fp, fn) running
+    counts. Returns ((precision, recall, f1), new_stats)."""
+    pred = probs >= threshold
+    truth = labels > 0.5
+    tp = stats[0] + (pred & truth).sum()
+    fp = stats[1] + (pred & ~truth).sum()
+    fn = stats[2] + (~pred & truth).sum()
+    p = tp / jnp.maximum(tp + fp, 1e-12)
+    r = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-12)
+    return (p, r, f1), jnp.stack([tp, fp, fn])

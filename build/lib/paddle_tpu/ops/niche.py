@@ -1,0 +1,371 @@
+"""The last of the reference's op surface: eight niche root ops.
+
+Reference kernels (all CPU-only or CPU+CUDA in the reference):
+- ``operators/sample_logits_op.cc`` + ``math/sample_prob.h`` (sampled
+  softmax preparation)
+- ``operators/unpool_op.cc`` + ``math/unpooling.cc`` (max-unpool by index)
+- ``operators/spp_op.cc`` (spatial pyramid pooling)
+- ``operators/conv_shift_op.cc`` (NTM circular correlation)
+- ``operators/tree_conv_op.cc`` + ``math/tree2col.cc`` (tree-based conv)
+- ``operators/var_conv_2d_op.cc`` (variable-size conv over LoD images)
+- ``operators/modified_huber_loss_op.cc``
+- ``operators/sequence_ops/sequence_topk_avg_pooling_op.cc``
+
+TPU-native design notes: every op here is static-shape (padded + masked
+where the reference used LoD), jittable except :func:`tree_conv`'s patch
+construction, which is data-dependent graph traversal done host-side in
+numpy (the reference kernel is likewise CPU-only; the differentiable
+contraction runs in XLA).
+"""
+
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# modified_huber_loss
+# ---------------------------------------------------------------------------
+
+@register_op("modified_huber_loss",
+             reference=lambda x, y: np.where(
+                 x * (2 * y - 1) < -1, -4 * x * (2 * y - 1),
+                 np.where(x * (2 * y - 1) < 1,
+                          (1 - x * (2 * y - 1)) ** 2, 0.0)))
+def modified_huber_loss(x, y):
+    """modified_huber_loss_op.h:41: with a = x * (2y - 1),
+    loss = -4a if a < -1; (1-a)^2 if -1 <= a < 1; 0 otherwise.
+    ``y`` must be {0, 1}. Autodiff reproduces the hand-written grad
+    kernel (both branches differentiate the same piecewise form)."""
+    a = x * (2.0 * y - 1.0)
+    return jnp.where(a < -1.0, -4.0 * a,
+                     jnp.where(a < 1.0, (1.0 - a) ** 2, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# unpool (max-unpool-2d)
+# ---------------------------------------------------------------------------
+
+@register_op("unpool")
+def unpool(x, indices, output_size):
+    """Max-unpooling (unpool_op.cc / math/unpooling.cc:21): scatter each
+    input value to its recorded argmax position. ``x``/``indices``
+    (N, C, h, w) NCHW, ``indices`` flat positions into the unpooled
+    (H, W) plane; ``output_size`` (H, W). Positions not hit stay 0."""
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    flat_x = x.reshape(n, c, h * w)
+    flat_i = indices.reshape(n, c, h * w).astype(jnp.int32)
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, flat_i, flat_x)
+    return out.reshape(n, c, oh, ow)
+
+
+# ---------------------------------------------------------------------------
+# spp (spatial pyramid pooling)
+# ---------------------------------------------------------------------------
+
+def _pool_level(x, ksize, stride, pad, pooling_type):
+    """One pyramid level: NCHW window-reduce with the reference's
+    exclusive-average semantics (pad cells don't count in the divisor)."""
+    kh, kw = ksize
+    sh, sw = stride
+    ph, pw = pad
+    dims = (1, 1, kh, kw)
+    strides = (1, 1, sh, sw)
+    padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if pooling_type == "max":
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, dims, strides, padding)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, padding)
+    ones = jnp.ones(x.shape[2:], x.dtype)
+    cnt = jax.lax.reduce_window(
+        ones, 0.0, jax.lax.add, (kh, kw), (sh, sw),
+        ((ph, ph), (pw, pw)))
+    return s / cnt[None, None]
+
+
+@register_op("spp")
+def spp(x, pyramid_height, pooling_type="max"):
+    """Spatial pyramid pooling (spp_op.h:28): level p pools into
+    2^p x 2^p bins with kernel ceil(dim/bins), pad
+    (kernel*bins - dim + 1)//2, stride = kernel; levels are flattened
+    and concatenated -> (N, C * sum_p 4^p)."""
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(pyramid_height):
+        bins = 2 ** p
+        kh = _pymath.ceil(h / bins)
+        kw = _pymath.ceil(w / bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        lvl = _pool_level(x, (kh, kw), (kh, kw), (ph, pw), pooling_type)
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# conv_shift (circular correlation)
+# ---------------------------------------------------------------------------
+
+def _conv_shift_ref(x, y):
+    b, m = x.shape
+    _, n = y.shape
+    half = (n - 1) // 2
+    out = np.zeros_like(x)
+    for i in range(m):
+        for j in range(-half, half + 1):
+            out[:, i] += x[:, (i + j) % m] * y[:, j + half]
+    return out
+
+
+@register_op("conv_shift", reference=_conv_shift_ref)
+def conv_shift(x, y):
+    """Circular correlation (conv_shift_op.cc:101, NTM attention shift):
+    Out[i] = sum_{j=-(N-1)/2}^{(N-1)/2} X[(i+j) mod M] * Y[j + (N-1)/2].
+    ``x`` (B, M), ``y`` (B, N) with N odd, N <= M."""
+    m = x.shape[1]
+    n = y.shape[1]
+    if n % 2 != 1:
+        raise ValueError(f"conv_shift filter width must be odd, got {n}")
+    if n > m:
+        raise ValueError(f"conv_shift filter width {n} exceeds data "
+                         f"width {m}")
+    half = (n - 1) // 2
+    # gather matrix of circular indices: idx[j, i] = (i + j - half) mod M
+    idx = (jnp.arange(m)[None, :] + jnp.arange(n)[:, None] - half) % m
+    gathered = x[:, idx]                       # (B, N, M)
+    return jnp.einsum("bnm,bn->bm", gathered, y)
+
+
+# ---------------------------------------------------------------------------
+# tree_conv
+# ---------------------------------------------------------------------------
+
+def _tree_patch_weights(edges, num_nodes, max_depth):
+    """Host-side tree2col (math/tree2col.cc:82): DFS patch per root with
+    continuous-binary-tree weights eta_t/l/r. Returns (P, N, 3) float32
+    where row p holds node weights for root p+1 (1-based nodes)."""
+    tr = [[] for _ in range(num_nodes + 1)]
+    for a, b in np.asarray(edges).reshape(-1, 2):
+        a, b = int(a), int(b)
+        if a == 0 and b == 0:
+            continue  # padded edge rows
+        tr[a].append(b)
+        tr[b].append(a)
+
+    weights = np.zeros((num_nodes, num_nodes, 3), np.float32)
+
+    def eta(index, pclen, depth):
+        eta_t = (max_depth - depth) / max_depth
+        if pclen == 1:
+            tmp = 0.5
+        else:
+            tmp = (index - 1.0) / (pclen - 1.0)
+        eta_l = (1.0 - eta_t) * tmp
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        return eta_l, eta_r, eta_t
+
+    for root in range(1, num_nodes + 1):
+        # iterative DFS mirroring Tree2ColUtil::construct_patch
+        visited = {root}
+        stack = [(root, 1, 1, 0)]
+        patch = [(root, 1, 1, 0)]
+        while stack:
+            node, _, _, depth = stack[-1]
+            advanced = False
+            children = tr[node]
+            for i, v in enumerate(children):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(children), depth + 1))
+                    patch.append((v, i + 1, len(children), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        for node, index, pclen, depth in patch:
+            el, er, et = eta(index, pclen, depth)
+            weights[root - 1, node - 1, 0] += el
+            weights[root - 1, node - 1, 1] += er
+            weights[root - 1, node - 1, 2] += et
+    return weights
+
+
+@register_op("tree_conv")
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2):
+    """Tree-based convolution (tree_conv_op.h:27, arXiv:1409.5718):
+    ``nodes_vector`` (B, N, F); ``edge_set`` (B, E, 2) int 1-based
+    (0,0 rows = padding); ``filter`` (F, 3, out_size, num_filters).
+    Returns (B, N, out_size, num_filters).
+
+    The DFS patch construction is data-dependent -> runs host-side in
+    numpy (the reference kernel is CPU-only for the same reason); the
+    contraction is XLA and differentiable wrt nodes_vector and filter."""
+    b, n, f = nodes_vector.shape
+    ws = np.stack([
+        _tree_patch_weights(np.asarray(edge_set[i]), n, max_depth)
+        for i in range(b)])                          # (B, N, N, 3)
+    ws = jnp.asarray(ws)
+    # patch[b, p, f, c] = sum_v ws[b, p, v, c] * nodes[b, v, f]
+    patch = jnp.einsum("bpvc,bvf->bpfc", ws, nodes_vector)
+    return jnp.einsum("bpfc,fcom->bpom", patch, filter)
+
+
+# ---------------------------------------------------------------------------
+# var_conv_2d
+# ---------------------------------------------------------------------------
+
+@register_op("var_conv_2d")
+def var_conv_2d(x, row_lens, col_lens, w, *, input_channel, output_channel,
+                kernel_h=3, kernel_w=3, stride_h=1, stride_w=1):
+    """Variable-size conv (var_conv_2d_op.cc:121). The reference packs
+    each sample's (h_i, w_i) image in a LoD tensor; here samples ride a
+    padded canvas ``x`` (B, C, Hmax, Wmax) with ``row_lens``/``col_lens``
+    (B,) giving true sizes. Kernel centers sit on a stride grid with
+    half-kernel zero borders (out-of-bounds taps read 0, exactly the
+    reference's im2col), output (B, OC, ceil(Hmax/sh), ceil(Wmax/sw))
+    masked to each sample's ceil(h_i/sh) x ceil(w_i/sw) region.
+
+    ``w`` is the reference layout (OC, C*kh*kw)."""
+    bsz, c, hm, wm = x.shape
+    if c != input_channel:
+        raise ValueError(f"x has {c} channels, expected {input_channel}")
+    half_h, half_w = kernel_h // 2, kernel_w // 2
+    out_h = (hm - 1) // stride_h + 1
+    out_w = (wm - 1) // stride_w + 1
+
+    # zero beyond each sample's true extent (reference reads 0 there)
+    rmask = jnp.arange(hm)[None, :] < row_lens[:, None]       # (B, Hm)
+    cmask = jnp.arange(wm)[None, :] < col_lens[:, None]       # (B, Wm)
+    x = x * (rmask[:, None, :, None] & cmask[:, None, None, :])
+
+    # pad so window i starts at i*stride - half_kernel
+    pad_h_hi = max(0, (out_h - 1) * stride_h - half_h + kernel_h - hm)
+    pad_w_hi = max(0, (out_w - 1) * stride_w - half_w + kernel_w - wm)
+    kernel = w.reshape(output_channel, input_channel, kernel_h, kernel_w)
+    out = jax.lax.conv_general_dilated(
+        x, kernel, (stride_h, stride_w),
+        ((half_h, pad_h_hi), (half_w, pad_w_hi)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    # mask outputs beyond each sample's top grid
+    to_h = jnp.where(row_lens > 0, (row_lens - 1) // stride_h + 1, 0)
+    to_w = jnp.where(col_lens > 0, (col_lens - 1) // stride_w + 1, 0)
+    omask = ((jnp.arange(out_h)[None, :] < to_h[:, None])[:, None, :, None]
+             & (jnp.arange(out_w)[None, :] < to_w[:, None])[:, None, None, :])
+    return out * omask
+
+
+# ---------------------------------------------------------------------------
+# sample_logits
+# ---------------------------------------------------------------------------
+
+def _tolerable(v):
+    """TolerableValue (sample_logits_op.h): clamp inf/nan like the
+    reference does before/after the logQ subtraction."""
+    big = jnp.asarray(1e20, v.dtype)
+    v = jnp.where(jnp.isnan(v), jnp.zeros_like(v), v)
+    return jnp.clip(v, -big, big)
+
+
+@register_op("sample_logits", has_grad=False)
+def sample_logits(logits, labels, num_samples, rng=None, *,
+                  remove_accidental_hits=True, customized_samples=None,
+                  customized_probabilities=None):
+    """Sampled-softmax preparation (sample_logits_op.h:148): returns
+    (samples (B, T+S), probabilities (B, T+S), sampled_logits (B, T+S),
+    sampled_labels (B, T) = arange(T)).
+
+    Negatives follow the log-uniform class distribution
+    Q(c) = log((c+2)/(c+1)) / log(range+1) (math/sampler.cc:56), drawn
+    with replacement and shared across the batch like the reference's
+    sampler; Q is scaled by num_samples (the reference's
+    num_tries==num_samples branch of adjust_prob, sample_prob.h:30 —
+    its uniquifying retry loop is host-side control flow; here the
+    with-replacement closed form keeps the op jittable). Pass
+    ``customized_samples``/``customized_probabilities`` to reproduce the
+    reference bit-for-bit (use_customized_samples=true path).
+
+    sampled_logits = gather(logits, samples) - log(Q), with accidental
+    hits (a negative equal to one of the row's true labels) pushed down
+    by 1e20 when ``remove_accidental_hits``."""
+    b, num_classes = logits.shape
+    num_true = labels.shape[1]
+    log_range = jnp.log(jnp.asarray(num_classes + 1.0, logits.dtype))
+
+    def q(v):
+        v = v.astype(logits.dtype)
+        return jnp.log((v + 2.0) / (v + 1.0)) / log_range
+
+    if customized_samples is not None:
+        if customized_probabilities is None:
+            raise ValueError("customized_samples requires "
+                             "customized_probabilities (the reference's "
+                             "use_customized_samples path takes both)")
+        samples = customized_samples
+        probabilities = customized_probabilities
+    else:
+        if rng is None:
+            raise ValueError("sample_logits needs a PRNG key when not "
+                             "given customized_samples")
+        u = jax.random.uniform(rng, (num_samples,), logits.dtype)
+        # inverse-transform log-uniform (sampler.cc:44)
+        neg = (jnp.exp(u * log_range) - 1.0).astype(jnp.int32) % num_classes
+        samples = jnp.concatenate(
+            [labels, jnp.broadcast_to(neg[None, :], (b, num_samples))], 1)
+        # adjust_prob, num_tries == num_samples branch (scales all columns)
+        probabilities = q(samples) * num_samples
+
+    sampled_logits = jnp.take_along_axis(logits, samples, axis=1)
+    if remove_accidental_hits:
+        negs = samples[:, num_true:]                     # (B, S)
+        hit = (negs[:, :, None] == labels[:, None, :]).any(-1)
+        sampled_logits = jnp.concatenate(
+            [sampled_logits[:, :num_true],
+             sampled_logits[:, num_true:] - 1e20 * hit], 1)
+    sampled_logits = _tolerable(
+        sampled_logits - _tolerable(jnp.log(probabilities)))
+    sampled_labels = jnp.broadcast_to(jnp.arange(num_true)[None, :],
+                                      (b, num_true))
+    return samples, probabilities, sampled_logits, sampled_labels
+
+
+# ---------------------------------------------------------------------------
+# sequence_topk_avg_pooling
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_topk_avg_pooling")
+def sequence_topk_avg_pooling(x, row_lens, col_lens, *, topks):
+    """sequence_topk_avg_pooling_op.h:64: per (sample, row, channel),
+    take the top-k values over the row's valid columns and emit their
+    average for each k in ``topks`` — dividing by k even when fewer than
+    k columns are valid (the reference saturates the running sum).
+
+    Dense layout: ``x`` (B, C, Rmax, Cmax) with ``row_lens``/``col_lens``
+    (B,) valid extents; returns (B, Rmax, C, len(topks)) with rows past
+    ``row_lens`` zeroed (the reference's LoD output only materializes
+    valid rows)."""
+    b, c, rm, cm = x.shape
+    topks = tuple(int(k) for k in topks)
+    max_k = max(topks)
+    if max_k > cm:
+        raise ValueError(f"topks={topks} exceed column capacity {cm}")
+    colmask = jnp.arange(cm)[None, :] < col_lens[:, None]     # (B, Cm)
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    masked = jnp.where(colmask[:, None, None, :], x, neg)
+    top = jax.lax.top_k(masked, max_k)[0]                     # (B,C,Rm,K)
+    # saturating prefix sum: invalid slots contribute 0
+    contrib = jnp.where(jnp.isfinite(top), top, 0.0)
+    csum = jnp.cumsum(contrib, axis=-1)
+    ks = jnp.asarray(topks) - 1
+    avg = csum[..., ks] / jnp.asarray(topks, x.dtype)         # (B,C,Rm,k)
+    rowmask = jnp.arange(rm)[None, :] < row_lens[:, None]     # (B, Rm)
+    avg = avg * rowmask[:, None, :, None]
+    return jnp.transpose(avg, (0, 2, 1, 3))                   # (B,Rm,C,k)

@@ -1,0 +1,94 @@
+"""Quantization ops: QAT fake-quant + PTQ scale observation.
+
+Reference mapping: ``contrib/slim/quantization`` +
+``operators/fake_quantize_op.cc`` (``fake_quantize_abs_max``,
+``fake_quantize_moving_average_abs_max``, ``fake_channel_wise_quantize``)
+— the graph-rewrite QuantizationTransformPass becomes simple function
+composition here (wrap a layer's matmul inputs with fake_quant).
+Straight-through estimator gradients via custom_vjp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+_ste_round.defvjp(lambda x: (jnp.round(x), None),
+                  lambda _, g: (g,))  # straight-through
+
+
+@jax.custom_vjp
+def _ste_clip(v):
+    return jnp.clip(v, -1.0, 1.0)
+
+
+# closed-interval mask: the max-|x| element sits exactly at the boundary,
+# where jnp.clip's min/max tie-splitting would halve the gradient; the
+# reference pass-through semantics give it gradient 1.
+_ste_clip.defvjp(lambda v: (jnp.clip(v, -1.0, 1.0), v),
+                 lambda v, g: (g * (jnp.abs(v) <= 1.0).astype(g.dtype),))
+
+
+@register_op("fake_quantize_abs_max")
+def fake_quantize_abs_max(x, bit_length: int = 8):
+    """Symmetric per-tensor fake quant with dynamic abs-max scale.
+    Returns (quantized-dequantized x, scale)."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    # scale is an observer, not a differentiable path: without stop_gradient
+    # the q*scale/qmax product leaks d(scale)/dx into the STE pass-through.
+    scale = jax.lax.stop_gradient(jnp.maximum(jnp.abs(x).max(), 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
+    return q * scale / qmax, scale
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8, axis: int = -1):
+    """Per-channel symmetric fake quant (conv/linear weights)."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    scale = jax.lax.stop_gradient(
+        jnp.maximum(jnp.abs(x).max(axis=reduce_axes, keepdims=True), 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
+    return q * scale / qmax, scale.squeeze()
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def fake_quantize_moving_average_abs_max(x, state_scale, *,
+                                         bit_length: int = 8,
+                                         momentum: float = 0.9,
+                                         training: bool = True):
+    """Activation fake quant with EMA abs-max scale (the QAT activation
+    observer). Returns (fq_x, new_state_scale)."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    if training:
+        cur = jnp.abs(x).max()
+        scale = momentum * state_scale + (1 - momentum) * cur
+    else:
+        scale = state_scale
+    scale = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = _ste_round(_ste_clip(x / scale) * qmax)
+    return q * scale / qmax, scale
+
+
+def quantize_weight_tree(params, bit_length: int = 8):
+    """PTQ: fake-quantize every float leaf named 'weight' per-channel on
+    the last dim (slim post-training pattern)."""
+    def walk(tree, name=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        if name == "weight" and hasattr(tree, "dtype") \
+                and jnp.issubdtype(tree.dtype, jnp.floating) \
+                and tree.ndim >= 2:
+            fq, _ = fake_channel_wise_quantize_abs_max(tree, bit_length)
+            return fq
+        return tree
+
+    return walk(params)

@@ -1,0 +1,52 @@
+"""Reduction ops (reference: ``operators/reduce_ops/`` — 28 files of
+reduce_{sum,mean,max,min,prod,all,any} + logsumexp; XLA's reduce covers all)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _norm_dim(dim):
+    if dim is None:
+        return None
+    if isinstance(dim, (list, tuple)):
+        return tuple(dim)
+    return (dim,)
+
+
+def _make(name, jfn, nfn, has_grad=True):
+    def ref(x, dim=None, keep_dim=False):
+        return nfn(x, axis=_norm_dim(dim), keepdims=keep_dim)
+
+    @register_op(f"reduce_{name}", reference=ref, has_grad=has_grad)
+    def op(x, dim=None, keep_dim=False):
+        return jfn(x, axis=_norm_dim(dim), keepdims=keep_dim)
+
+    op.__name__ = f"reduce_{name}"
+    op.__doc__ = f"reduce_{name} (fluid operators/reduce_ops/reduce_{name}_op)."
+    return op
+
+
+reduce_sum = _make("sum", jnp.sum, np.sum)
+reduce_mean = _make("mean", jnp.mean, np.mean)
+reduce_max = _make("max", jnp.max, np.max)
+reduce_min = _make("min", jnp.min, np.min)
+reduce_prod = _make("prod", jnp.prod, np.prod)
+reduce_all = _make("all", jnp.all, np.all, has_grad=False)
+reduce_any = _make("any", jnp.any, np.any, has_grad=False)
+
+
+@register_op("logsumexp", reference=lambda x, dim=None, keep_dim=False:
+             np.log(np.sum(np.exp(x), axis=_norm_dim(dim), keepdims=keep_dim)))
+def logsumexp(x, dim=None, keep_dim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_dim(dim), keepdims=keep_dim)
+
+
+@register_op("mean", reference=lambda x: np.mean(x))
+def mean(x):
+    """Global mean (fluid mean_op — the canonical loss reducer)."""
+    return jnp.mean(x)

@@ -1,0 +1,324 @@
+"""Sequence ops over padded+lengths batches (the LoD world, TPU-native).
+
+Reference mapping: ``operators/sequence_ops/`` (47 files — seq_pool,
+seq_expand, seq_pad/unpad, seq_mask, seq_softmax, seq_concat, seq_reverse
+over LoD ragged tensors, SURVEY.md §2.3). XLA needs static shapes, so the
+ragged representation is (data (B, T, ...), lengths (B,)) — sequence_pad
+parity is the representation itself; each op masks by lengths. Segment
+variants (segment_sum style) cover the packed-sequence layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("sequence_mask")
+def sequence_mask(lengths, maxlen=None, dtype=jnp.bool_):
+    """(B,) lengths -> (B, T) validity mask (sequence_mask_op)."""
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))  # requires concrete lengths
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Pool (B, T, D) over valid positions (sequence_pool_op:
+    sum/average/sqrt/max/last/first)."""
+    mask = sequence_mask(lengths, x.shape[1], x.dtype)[..., None]
+    if pool_type == "sum":
+        return (x * mask).sum(1)
+    if pool_type in ("average", "mean"):
+        denom = jnp.maximum(lengths[:, None], 1).astype(x.dtype)
+        return (x * mask).sum(1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(lengths[:, None], 1).astype(x.dtype))
+        return (x * mask).sum(1) / denom
+    if pool_type == "max":
+        neg = jnp.finfo(x.dtype).min
+        return jnp.where(mask > 0, x, neg).max(1)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None].repeat(
+            x.shape[-1], -1), axis=1)[:, 0]
+    if pool_type == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(x, lengths):
+    """Masked softmax over the time dim (sequence_softmax_op)."""
+    mask = sequence_mask(lengths, x.shape[1], jnp.bool_)
+    neg = jnp.asarray(-1e30, x.dtype)
+    z = jnp.where(mask, x, neg)
+    p = jax.nn.softmax(z, axis=1)
+    return jnp.where(mask, p, 0.0)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, lengths):
+    """Reverse each row's valid prefix, keeping padding in place
+    (sequence_reverse_op)."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(
+        x, src[..., None].repeat(x.shape[-1], -1) if x.ndim == 3 else src,
+        axis=1)
+
+
+@register_op("sequence_expand")
+def sequence_expand(x, times):
+    """Repeat each row i times[i] — static variant requires equal times
+    (LoD expand is data-dependent; use repeat for the general host-side
+    case). times: python int."""
+    return jnp.repeat(x, times, axis=0)
+
+
+@register_op("sequence_pad")
+def sequence_pad(rows, maxlen, pad_value=0.0):
+    """Host-side helper: list of (len_i, D) arrays -> (B, maxlen, D),
+    lengths. (sequence_pad_op — here padding happens at ingest, matching
+    the native feed's ragged slots.)"""
+    import numpy as np
+
+    b = len(rows)
+    d = np.shape(rows[0])[-1] if np.ndim(rows[0]) > 1 else None
+    shape = (b, maxlen, d) if d else (b, maxlen)
+    out = np.full(shape, pad_value, dtype=np.asarray(rows[0]).dtype)
+    lengths = np.zeros((b,), np.int64)
+    for i, r in enumerate(rows):
+        r = np.asarray(r)
+        n = min(len(r), maxlen)
+        out[i, :n] = r[:n]
+        lengths[i] = n
+    return jnp.asarray(out), jnp.asarray(lengths)
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(x, lengths):
+    """(B, T, ...) -> list of valid prefixes (host-side)."""
+    import numpy as np
+
+    xs = np.asarray(x)
+    ls = np.asarray(lengths)
+    return [xs[i, :ls[i]] for i in range(xs.shape[0])]
+
+
+@register_op("sequence_conv")
+def sequence_conv(x, lengths, filter_weight, context_start=-1,
+                  padding_value=0.0):
+    """Context-window convolution over time (sequence_conv_op): at each
+    step t, the rows x[t+context_start : t+context_start+ctx_len] are
+    concatenated and matmul'd with ``filter_weight``
+    ((ctx_len*D, F)). Positions beyond each row's length are masked.
+    x: (B, T, D) -> (B, T, F)."""
+    b, t, d = x.shape
+    ctx_len = filter_weight.shape[0] // d
+    mask = sequence_mask(lengths, t, x.dtype)[..., None]
+    xm = x * mask + padding_value * (1 - mask)
+    cols = []
+    for j in range(ctx_len):
+        off = context_start + j
+        shifted = jnp.roll(xm, -off, axis=1)
+        pos = jnp.arange(t)
+        valid = (pos + off >= 0) & (pos + off < t)
+        cols.append(jnp.where(valid[None, :, None], shifted,
+                              padding_value))
+    ctx = jnp.concatenate(cols, axis=-1)           # (B, T, ctx_len*D)
+    out = jnp.einsum("btc,cf->btf", ctx, filter_weight)
+    return out * mask
+
+
+@register_op("sequence_slice")
+def sequence_slice(x, lengths, offsets, slice_lengths):
+    """Per-row slice of the valid prefix (sequence_slice_op): row i keeps
+    x[i, offsets[i] : offsets[i]+slice_lengths[i]], left-aligned into the
+    same (B, T, ...) shape with zeros after; returns (out, new_lengths)."""
+    b, t = x.shape[:2]
+    pos = jnp.arange(t)
+    src = offsets[:, None] + pos[None, :]          # (B, T) gather index
+    valid = (pos[None, :] < slice_lengths[:, None]) & \
+        (src < lengths[:, None])
+    src = jnp.clip(src, 0, t - 1)
+    if x.ndim == 2:
+        gathered = jnp.take_along_axis(x, src, axis=1)
+    else:
+        gathered = jnp.take_along_axis(
+            x, src[..., None].repeat(x.shape[-1], -1), axis=1)
+    shape = valid.shape + (1,) * (x.ndim - 2)
+    out = jnp.where(valid.reshape(shape), gathered, 0)
+    new_len = jnp.minimum(slice_lengths,
+                          jnp.maximum(lengths - offsets, 0))
+    return out, new_len
+
+
+@register_op("sequence_erase")
+def sequence_erase(x, lengths, tokens):
+    """Remove every occurrence of ``tokens`` from each row's valid prefix
+    (sequence_erase_op), left-compacting survivors. x: (B, T) int;
+    returns (out (B, T), new_lengths)."""
+    b, t = x.shape
+    tokens = jnp.asarray(tokens).reshape(-1)
+    valid = sequence_mask(lengths, t, jnp.bool_)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable left-compaction: sort by (dropped, original position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + 1),
+                        axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1)
+    out_mask = jnp.arange(t)[None, :] < new_len[:, None]
+    return jnp.where(out_mask, compacted, 0), new_len
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(x, lengths, win_size, pad_value=0):
+    """Sliding windows over each row (sequence_enumerate_op): output
+    (B, T, win_size) where out[b, t] = x[b, t:t+win], positions past the
+    row's length filled with ``pad_value``."""
+    b, t = x.shape
+    wins = []
+    for j in range(win_size):
+        shifted = jnp.roll(x, -j, axis=1)
+        valid = (jnp.arange(t)[None, :] + j) < lengths[:, None]
+        wins.append(jnp.where(valid, shifted, pad_value))
+    return jnp.stack(wins, axis=-1)
+
+
+@register_op("sequence_concat")
+def sequence_concat(x, x_lengths, y, y_lengths, pad_value=0):
+    """Row-wise ragged concat (sequence_concat_op): row i becomes
+    x[i,:lx] ++ y[i,:ly], padded to Tx+Ty; returns (out, lengths).
+    x/y: (B, T) or (B, T, D)."""
+    b, tx = x.shape[:2]
+    ty = y.shape[1]
+    t_out = tx + ty
+    pos = jnp.arange(t_out)[None, :]
+    from_x = pos < x_lengths[:, None]
+    y_idx = jnp.clip(pos - x_lengths[:, None], 0, ty - 1)
+    x_idx = jnp.clip(pos, 0, tx - 1)
+
+    def gather(arr, idx):
+        if arr.ndim == 2:
+            return jnp.take_along_axis(arr, idx, axis=1)
+        return jnp.take_along_axis(
+            arr, idx[..., None].repeat(arr.shape[-1], -1), axis=1)
+
+    sel = from_x if x.ndim == 2 else from_x[..., None]
+    out = jnp.where(sel, gather(x, x_idx), gather(y, y_idx))
+    new_len = x_lengths + y_lengths
+    keep = pos < new_len[:, None]
+    if x.ndim == 3:
+        keep = keep[..., None]
+    return jnp.where(keep, out, pad_value), new_len
+
+
+# -- packed-segment variants (sequence packing for long-context training) --
+
+@register_op("segment_sum")
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@register_op("segment_max")
+def segment_max(data, segment_ids, num_segments):
+    return jax.ops.segment_max(data, segment_ids, num_segments)
+
+
+def make_segment_attention_bias(segment_ids, kv_segment_ids=None,
+                                dtype=jnp.float32):
+    """Packed sequences: (B, Tq) segment ids -> additive (B,1,Tq,Tkv)
+    bias blocking cross-segment attention (the packed-batch story for
+    Transformer-big variable-length training; ≙ LoD isolation between
+    sequences). Pass ``kv_segment_ids`` for cross-attention between two
+    packed streams (decoder queries vs encoder keys: a pair shares its
+    segment number across streams)."""
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    same = segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+    return jnp.where(same, 0.0, -1e30).astype(dtype)[:, None, :, :]
+
+
+@register_op("sequence_first_step")
+def sequence_first_step(x, lengths):
+    """sequence_first_step (sequence_pool FIRST): (B, T, ...) -> (B, ...)."""
+    del lengths  # first step is index 0 regardless
+    return x[:, 0]
+
+
+@register_op("sequence_last_step")
+def sequence_last_step(x, lengths):
+    """sequence_last_step (sequence_pool LAST)."""
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(
+        x, idx.reshape(-1, *([1] * (x.ndim - 1))), axis=1)[:, 0]
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(x, ref_lengths, maxlen):
+    """sequence_expand_as_op: repeat row i of x ``ref_lengths[i]`` times
+    into a padded (B, maxlen, ...) layout (LoD -> padded analog)."""
+    out = jnp.repeat(x[:, None], maxlen, axis=1)
+    mask = jnp.arange(maxlen)[None, :] < ref_lengths[:, None]
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(
+        x.dtype)
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(x, lengths, new_dim):
+    """sequence_reshape_op: re-chunk each row's valid timesteps into
+    ``new_dim``-wide steps. Padded form: (B, T, D) -> (B, T*D//new_dim,
+    new_dim) with adjusted lengths (valid elements preserved)."""
+    b, t, d = x.shape
+    if (t * d) % new_dim:
+        raise ValueError(f"T*D={t*d} not divisible by new_dim={new_dim}")
+    # per-row validity (the reference raises per sequence; raising on
+    # data-dependent values is impossible under jit): rows whose
+    # lengths*d is not divisible by new_dim get length -1 as an explicit
+    # in-band error the caller must check — never a silent truncation
+    divisible = (lengths * d) % new_dim == 0
+    new_lengths = jnp.where(divisible, lengths * d // new_dim, -1)
+    out = x.reshape(b, t * d // new_dim, new_dim)
+    return out, new_lengths
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, index, updates, lengths):
+    """sequence_scatter_op: per-row scatter-add of updates at index
+    positions (positions past lengths ignored)."""
+    b, k = index.shape
+    valid = jnp.arange(k)[None, :] < lengths[:, None]
+    upd = jnp.where(valid, updates, 0.0)
+
+    def one(row, idx, u):
+        return row.at[idx].add(u)
+
+    return jax.vmap(one)(x, index, upd)
+
+
+def dynamic_lstm(x, lengths, params, cell):
+    """layers.dynamic_lstm surface (dynamic_lstm_op): ragged-batch LSTM.
+    TPU-native form: the ``nn.rnn`` scan cells on padded rows + lengths
+    (the LoD analog) — ``cell`` is an ``nn.rnn.LSTMCell``-wrapped ``RNN``
+    layer, ``params`` its params."""
+    return cell(params, x, lengths)
+
+
+def dynamic_gru(x, lengths, params, cell):
+    """layers.dynamic_gru surface (dynamic_gru_op) — see dynamic_lstm."""
+    return cell(params, x, lengths)
+
+
+def lstm_unit(params, state, x, cell):
+    """layers.lstm_unit (lstm_unit_op): one LSTMCell step."""
+    return cell(params, state, x)
+
+
+def gru_unit(params, state, x, cell):
+    """layers.gru_unit (gru_unit_op): one GRUCell step."""
+    return cell(params, state, x)

@@ -1,0 +1,600 @@
+"""Tensor manipulation ops (reference: fluid's concat/split/reshape/transpose/
+gather/scatter/top_k/argsort/cast/fill/assign op families in
+``paddle/fluid/operators/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.dtypes import convert_dtype
+
+
+@register_op("concat", reference=lambda xs, axis=0: np.concatenate(xs, axis))
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0):
+    """fluid split_op: int -> equal parts; list -> section sizes."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    bounds = np.cumsum(num_or_sections)[:-1].tolist()
+    return jnp.split(x, bounds, axis=axis)
+
+
+@register_op("stack", reference=lambda xs, axis=0: np.stack(xs, axis))
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("unstack", has_grad=True)
+def unstack(x, axis=0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+
+
+@register_op("reshape", reference=lambda x, shape: np.reshape(x, shape))
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register_op("squeeze", reference=lambda x, axes=None: np.squeeze(x, tuple(axes) if axes else None))
+def squeeze(x, axes=None):
+    return jnp.squeeze(x, tuple(axes) if axes else None)
+
+
+@register_op("unsqueeze", reference=lambda x, axes: np.expand_dims(x, tuple(axes) if isinstance(axes, (list, tuple)) else axes))
+def unsqueeze(x, axes):
+    return jnp.expand_dims(x, tuple(axes) if isinstance(axes, (list, tuple)) else axes)
+
+
+@register_op("flatten")
+def flatten(x, axis=1):
+    """fluid flatten_op: collapse dims before/after ``axis`` into a matrix."""
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("transpose", reference=lambda x, perm: np.transpose(x, perm))
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+import builtins
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends):  # noqa: A001 - fluid op name
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@register_op("gather", reference=lambda x, index: np.take(x, index, 0))
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    """fluid scatter_op: write rows of ``updates`` at ``index``."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@register_op("top_k", has_grad=False)
+def top_k(x, k):
+    return jax.lax.top_k(x, k)
+
+
+@register_op("argsort", has_grad=False,
+             reference=lambda x, axis=-1: (np.sort(x, axis), np.argsort(x, axis, kind="stable")))
+def argsort(x, axis=-1):
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    return jnp.take_along_axis(x, idx, axis=axis), idx
+
+
+@register_op("argmax", has_grad=False, reference=lambda x, axis=-1: np.argmax(x, axis))
+def argmax(x, axis=-1):
+    return jnp.argmax(x, axis=axis)
+
+
+@register_op("argmin", has_grad=False, reference=lambda x, axis=-1: np.argmin(x, axis))
+def argmin(x, axis=-1):
+    return jnp.argmin(x, axis=axis)
+
+
+@register_op("cast", reference=lambda x, dtype: np.asarray(x).astype(dtype))
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("fill_constant", has_grad=False)
+def fill_constant(shape, dtype, value):
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+@register_op("zeros_like", has_grad=False, reference=np.zeros_like)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like", has_grad=False, reference=np.ones_like)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("assign", reference=np.asarray)
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("expand", reference=lambda x, times: np.tile(x, times))
+def expand(x, expand_times):
+    return jnp.tile(x, expand_times)
+
+
+@register_op("expand_as")
+def expand_as(x, target):
+    return jnp.broadcast_to(x, target.shape)
+
+
+@register_op("tile", reference=np.tile)
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+@register_op("where", reference=np.where)
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register_op("masked_select", has_grad=False)
+def masked_select(x, mask, size=None):
+    """Static-shape variant: requires ``size`` (XLA has no dynamic output
+    shapes); pads with zeros. fluid's masked_select is dynamic."""
+    if size is None:
+        raise ValueError("TPU masked_select needs a static `size`")
+    idx = jnp.nonzero(mask.reshape(-1), size=size, fill_value=0)[0]
+    return x.reshape(-1)[idx]
+
+
+@register_op("range", has_grad=False, reference=lambda s, e, st: np.arange(s, e, st))
+def arange(start, end, step=1, dtype=jnp.int32):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+@register_op("linspace", has_grad=False)
+def linspace(start, stop, num, dtype=jnp.float32):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+@register_op("shape", has_grad=False)
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register_op("eye", has_grad=False)
+def eye(num_rows, num_cols=None, dtype=jnp.float32):
+    return jnp.eye(num_rows, num_cols, dtype=convert_dtype(dtype))
+
+
+@register_op("diag", has_grad=False)
+def diag(x):
+    return jnp.diag(x)
+
+
+@register_op("flip", reference=lambda x, axis: np.flip(x, axis))
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@register_op("isfinite", has_grad=False, reference=np.isfinite)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register_op("isnan", has_grad=False, reference=np.isnan)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("increment")
+def increment(x, value=1.0):
+    return x + value
+
+
+@register_op("accuracy", has_grad=False)
+def accuracy(logits_or_topk, label, k=1):
+    """fluid accuracy_op (operators/metrics/accuracy_op)."""
+    _, pred = jax.lax.top_k(logits_or_topk, k)
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(pred == lbl, axis=1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+# -- tensor long tail (root-op breadth) -------------------------------------
+
+@register_op("tril", reference=lambda x, diagonal=0: np.tril(x, diagonal))
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@register_op("triu", reference=lambda x, diagonal=0: np.triu(x, diagonal))
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@register_op("meshgrid", has_grad=False)
+def meshgrid(*xs, indexing="ij"):
+    """fluid meshgrid_op (default 'ij' like the reference)."""
+    return jnp.meshgrid(*xs, indexing=indexing)
+
+
+@register_op("kron", reference=np.kron)
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("unique", has_grad=False)
+def unique(x, return_counts=False):
+    """fluid unique_op: sorted unique values (+ counts). Static-shape
+    caveat: under jit, use size= via jnp.unique kwargs at call site."""
+    return jnp.unique(jnp.ravel(x), return_counts=return_counts)
+
+
+@register_op("nonzero", has_grad=False)
+def nonzero(x):
+    """where_index_op: indices of nonzero elements, (N, ndim). Host/eager
+    only (data-dependent shape)."""
+    return jnp.stack(jnp.nonzero(x), axis=-1)
+
+
+@register_op("index_select",
+             reference=lambda x, index, axis=0: np.take(x, index, axis))
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("index_sample", reference=lambda x, index:
+             np.take_along_axis(x, index, axis=1))
+def index_sample(x, index):
+    """index_sample_op: per-row gather — out[i, j] = x[i, index[i, j]]."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op("multiplex", reference=lambda index, *xs:
+             np.stack(xs)[index.ravel(), np.arange(index.size)])
+def multiplex(index, *xs):
+    """multiplex_op: row i of the output comes from candidate xs[index[i]]."""
+    stacked = jnp.stack(xs)                      # (C, B, ...)
+    idx = jnp.ravel(index)
+    return stacked[idx, jnp.arange(idx.shape[0])]
+
+
+@register_op("unfold", reference=None)
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    """unfold_op (im2col): (N, C, H, W) -> (N, C*kh*kw, L) like the
+    reference's NCHW layout."""
+    n, c, h, w = x.shape
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else kernel_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                       j * dw:j * dw + (ow - 1) * sw + 1:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)                # (N, C, kh*kw, oh, ow)
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("pixel_shuffle", reference=None)
+def pixel_shuffle(x, upscale_factor):
+    """pixel_shuffle_op: (N, C*r^2, H, W) -> (N, C, H*r, W*r) (NCHW)."""
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("shuffle_channel", reference=None)
+def shuffle_channel(x, group):
+    """shuffle_channel_op (ShuffleNet): (N, C, H, W) group interleave."""
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+@register_op("temporal_shift", reference=None)
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    """temporal_shift_op (TSM): x (N*T, C, H, W); shift 1/4 channels one
+    frame back, 1/4 one frame forward, rest unchanged."""
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    x = x.reshape(n, t, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, c1:c2]), x[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, x[:, :, c2:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+@register_op("crop", reference=None)
+def crop(x, offsets, shape):
+    """crop_op / crop_tensor_op: static slice at offsets with out shape."""
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("gaussian_random", has_grad=False)
+def gaussian_random(key, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    """gaussian_random_op — FUNCTIONAL: the PRNG key is explicit (no
+    global generator state on TPU; fluid's seed attr becomes the key)."""
+    return mean + std * jax.random.normal(key, tuple(shape), dtype)
+
+
+@register_op("uniform_random", has_grad=False)
+def uniform_random(key, shape, min=-1.0, max=1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, tuple(shape), dtype, min, max)
+
+
+@register_op("randint", has_grad=False)
+def randint(key, low, high, shape):
+    return jax.random.randint(key, tuple(shape), low, high)
+
+
+@register_op("randperm", has_grad=False)
+def randperm(key, n):
+    return jax.random.permutation(key, n)
+
+
+@register_op("shard_index", has_grad=False)
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """shard_index_op (PS-world id localization): ids owned by this shard
+    map to local ids, others to ignore_value."""
+    shard_size = (index_num + nshards - 1) // nshards
+    owner = x // shard_size
+    local = x % shard_size
+    return jnp.where(owner == shard_id, local, ignore_value)
+
+
+# ---------------------------------------------------------------------------
+# creation / shape-query tail (fill_constant_op.cc, scale_op.cc,
+# sign_op.cc, rank/size/sum surfaces of fluid layers/tensor.py)
+# ---------------------------------------------------------------------------
+
+@register_op("ones", reference=None, has_grad=False)
+def ones(shape, dtype=jnp.float32):
+    """layers.ones (fill_constant value=1)."""
+    return jnp.ones(shape, convert_dtype(dtype))
+
+
+@register_op("zeros", reference=None, has_grad=False)
+def zeros(shape, dtype=jnp.float32):
+    """layers.zeros (fill_constant value=0)."""
+    return jnp.zeros(shape, convert_dtype(dtype))
+
+
+@register_op("scale", reference=None)
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """scale_op: x*s + b (or (x+b)*s)."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("sign", reference=np.sign)
+def sign(x):
+    """sign_op."""
+    return jnp.sign(x)
+
+
+@register_op("rank", reference=None, has_grad=False)
+def rank(x):
+    """layers.rank: 0-d int tensor with the rank."""
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+@register_op("size", reference=None, has_grad=False)
+def size(x):
+    """size_op: total element count (int32 unless x64 is enabled — JAX
+    truncates int64 silently otherwise)."""
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(x.size, dt)
+
+
+@register_op("sum", reference=None)
+def sum_op(xs):
+    """sum_op: elementwise sum of a LIST of tensors (grad fan-out)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+sums = sum_op  # layers.sums alias
+
+
+@register_op("fill_constant_batch_size_like", reference=None,
+             has_grad=False)
+def fill_constant_batch_size_like(ref, shape, value, dtype=jnp.float32,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """fill_constant_batch_size_like_op: shape with one dim copied from a
+    reference tensor's batch dim."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(shape, value, convert_dtype(dtype))
+
+
+@register_op("gaussian_random_batch_size_like", reference=None,
+             has_grad=False)
+def gaussian_random_batch_size_like(ref, shape, key, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0):
+    """gaussian_random_batch_size_like_op (explicit PRNG key — TPU-native
+    randomness is functional, no global generator state)."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return mean + std * jax.random.normal(key, tuple(shape))
+
+
+@register_op("uniform_random_batch_size_like", reference=None,
+             has_grad=False)
+def uniform_random_batch_size_like(ref, shape, key, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0):
+    """uniform_random_batch_size_like_op."""
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jax.random.uniform(key, tuple(shape), minval=min, maxval=max)
+
+
+@register_op("reverse", reference=None)
+def reverse(x, axis):
+    """reverse_op: flip along the given axes."""
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis)
+
+
+@register_op("is_empty", reference=None, has_grad=False)
+def is_empty(x):
+    """is_empty_op."""
+    return jnp.asarray(x.size == 0)
+
+
+@register_op("has_inf", reference=None, has_grad=False)
+def has_inf(x):
+    """isfinite_op variant: any(|x| == inf)."""
+    return jnp.isinf(x).any()
+
+
+@register_op("has_nan", reference=None, has_grad=False)
+def has_nan(x):
+    """isfinite_op variant: any(x != x)."""
+    return jnp.isnan(x).any()
+
+
+@register_op("sampling_id", reference=None, has_grad=False)
+def sampling_id(probs, key):
+    """sampling_id_op: sample a column index per row of a probability
+    matrix (explicit key; reference uses a global generator)."""
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)),
+                                  axis=-1)
+
+
+@register_op("random_crop", reference=None, has_grad=False)
+def random_crop(x, crop_shape, key):
+    """random_crop_op: same random crop offsets for the whole batch dim 0
+    are NOT shared — per-sample offsets like the reference."""
+    b = x.shape[0]
+    ndim = len(crop_shape)
+    spatial = x.shape[1:1 + ndim]
+    keys = jax.random.split(key, b)
+
+    def one(img, k):
+        ks = jax.random.split(k, ndim)
+        starts = [jax.random.randint(ks[i], (), 0,
+                                     spatial[i] - crop_shape[i] + 1)
+                  for i in range(ndim)]
+        starts = starts + [0] * (img.ndim - ndim)
+        sizes = list(crop_shape) + list(img.shape[ndim:])
+        return jax.lax.dynamic_slice(img, starts, sizes)
+
+    return jax.vmap(one)(x, keys)
+
+
+@register_op("pad_constant_like", reference=None)
+def pad_constant_like(ref, x, pad_value=0.0):
+    """pad_constant_like_op: pad x up to ref's shape (trailing pads)."""
+    pads = [(0, r - s) for r, s in zip(ref.shape, x.shape)]
+    return jnp.pad(x, pads, constant_values=pad_value)
+
+
+@register_op("scatter_nd", reference=None)
+def scatter_nd(index, updates, shape):
+    """scatter_nd_op: zeros(shape) with updates added at index rows."""
+    out = jnp.zeros(shape, updates.dtype)
+    return out.at[tuple(index[..., i] for i in range(index.shape[-1]))
+                  ].add(updates)
+
+
+@register_op("unique_with_counts", reference=None, has_grad=False)
+def unique_with_counts(x, *, size=None):
+    """unique_with_counts_op. XLA needs static shapes: ``size`` bounds the
+    output (default len(x)); absent slots are filled with the first unique
+    value and zero counts."""
+    size = size or x.shape[0]
+    uniq, idx, counts = jnp.unique(x, return_inverse=True,
+                                   return_counts=True, size=size,
+                                   fill_value=x[0])
+    return uniq, idx, counts
+
+
+@register_op("hash", reference=None, has_grad=False)
+def hash_op(x, mod_by=100000007, num_hash=1):
+    """hash_op (Pyramid hash trick): deterministic int hashing of id
+    tensors into ``num_hash`` buckets spaces — multiplicative hashing
+    (knuth) instead of the reference's xxhash; same contract (stable,
+    spread), different constants."""
+    x = x.astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761)
+             + jnp.uint32((i * 0x9E3779B9) & 0xFFFFFFFF))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        outs.append((h % jnp.uint32(mod_by)).astype(dt))
+    return outs[0] if num_hash == 1 else jnp.stack(outs, -1)
+
+
+def crop_tensor(x, shape, offsets=None):
+    """layers.crop_tensor (crop_tensor_op): static-offset crop."""
+    offsets = offsets or [0] * x.ndim
+    return jax.lax.slice(x, offsets,
+                         [o + s for o, s in zip(offsets, shape)])

@@ -1,0 +1,447 @@
+"""Optimizers as pure pytree update rules.
+
+Parity surface: ``python/paddle/fluid/optimizer.py`` (SGD:647, Momentum:717,
+LarsMomentum:1087, Adagrad:1187, Adam:1297, Adamax:1487, DecayedAdagrad:1726,
+Adadelta:1821, RMSProp:1927, Ftrl:2100, Lamb:2244, ModelAverage:2399,
+ExponentialMovingAverage:2701, RecomputeOptimizer:3224, LookaheadOptimizer:3517)
+plus AdamW. The reference's ``minimize`` appends backward + per-param
+optimizer ops into the program; here an optimizer is
+``init(params) -> state`` and ``update(grads, state, params) -> (params,
+state)``, both jit-safe pure functions. Sparse (SelectedRows) code paths are
+unnecessary — embedding grads arrive as dense scatter-adds from XLA.
+
+All slot buffers are stored in a dict state pytree:
+``{"step": int32, "slots": {name: tree-like-params}}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import lr_scheduler
+from paddle_tpu.optimizer.clip import (GradClipBase, GradientClipByGlobalNorm,
+                                       GradientClipByNorm, GradientClipByValue,
+                                       global_norm)
+from paddle_tpu.optimizer.regularizer import L1Decay, L2Decay
+
+tmap = jax.tree_util.tree_map
+
+
+from paddle_tpu.optimizer import compression  # noqa: E402  (DGC, LocalSGD)
+
+
+def _zeros_like_tree(params):
+    return tmap(jnp.zeros_like, params)
+
+
+class Optimizer:
+    """Base optimizer.
+
+    ``learning_rate`` is a float or a ``step -> lr`` schedule. ``grad_clip``
+    is a clip.GradClipBase; ``regularization`` an L1/L2 decay applied to
+    grads before the rule (fluid semantics)."""
+
+    SLOTS = ()
+
+    def __init__(self, learning_rate=0.001, regularization=None,
+                 grad_clip: Optional[GradClipBase] = None, name=None):
+        self._lr = (learning_rate if callable(learning_rate)
+                    else lr_scheduler.constant(learning_rate))
+        self.regularization = regularization
+        self.grad_clip = grad_clip
+        self.name = name
+
+    # -- state ------------------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {s: _zeros_like_tree(params) for s in self.SLOTS},
+        }
+
+    # -- update -----------------------------------------------------------
+    def update(self, grads, state, params, mask=None):
+        """Apply one optimizer step. ``mask``: pytree of bools — False leaves
+        (non-trainable, e.g. BN running stats) pass through untouched."""
+        if self.regularization is not None:
+            grads = self.regularization(grads, params)
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = state["step"] + 1
+        lr = self._lr(step)
+        slots = state["slots"]
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_slots = {s: treedef.flatten_up_to(slots[s]) for s in self.SLOTS}
+        flat_mask = (treedef.flatten_up_to(mask) if mask is not None
+                     else [True] * len(flat_p))
+
+        new_p, new_slots = [], {s: [] for s in self.SLOTS}
+        for i, (p, g, m) in enumerate(zip(flat_p, flat_g, flat_mask)):
+            sl = {s: flat_slots[s][i] for s in self.SLOTS}
+            if g is None:
+                m = False
+            if m is False:  # statically non-trainable
+                p2, sl2 = p, sl
+            else:
+                p2, sl2 = self._apply(g, p, sl, lr, step)
+            new_p.append(p2)
+            for s in self.SLOTS:
+                new_slots[s].append(sl2[s])
+        params_out = jax.tree_util.tree_unflatten(treedef, new_p)
+        slots_out = {s: jax.tree_util.tree_unflatten(treedef, new_slots[s])
+                     for s in self.SLOTS}
+        return params_out, {"step": step, "slots": slots_out}
+
+    def _apply(self, g, p, slots, lr, step):
+        raise NotImplementedError
+
+    # -- fluid-style convenience -----------------------------------------
+    def minimize(self, loss_fn, params, state, *args, mask=None, **kwargs):
+        """One fused backward+apply step (fluid Optimizer.minimize:598).
+        Returns (loss, new_params, new_state)."""
+        loss, grads = jax.value_and_grad(loss_fn)(params, *args, **kwargs)
+        params, state = self.update(grads, state, params, mask=mask)
+        return loss, params, state
+
+
+class SGD(Optimizer):
+    def _apply(self, g, p, slots, lr, step):
+        return p - lr * g.astype(p.dtype), slots
+
+
+class Momentum(Optimizer):
+    SLOTS = ("velocity",)
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.mu = momentum
+        self.nesterov = use_nesterov
+
+    def _apply(self, g, p, slots, lr, step):
+        v = self.mu * slots["velocity"] + g
+        if self.nesterov:
+            upd = g + self.mu * v
+        else:
+            upd = v
+        return p - lr * upd.astype(p.dtype), {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (fluid LarsMomentumOptimizer:1087) — layerwise-adaptive rate."""
+
+    SLOTS = ("velocity",)
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=1e-9, **kw):
+        super().__init__(learning_rate, **kw)
+        self.mu, self.coeff = momentum, lars_coeff
+        self.wd, self.eps = lars_weight_decay, epsilon
+
+    def _apply(self, g, p, slots, lr, step):
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local = self.coeff * pn / (gn + self.wd * pn + self.eps)
+        local = jnp.where(jnp.logical_or(pn == 0, gn == 0), 1.0, local)
+        v = self.mu * slots["velocity"] + lr * local * (g + self.wd * p)
+        return p - v.astype(p.dtype), {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    SLOTS = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.eps = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def init(self, params):
+        st = super().init(params)
+        if self.init_acc:
+            st["slots"]["moment"] = tmap(
+                lambda p: jnp.full_like(p, self.init_acc), params)
+        return st
+
+    def _apply(self, g, p, slots, lr, step):
+        m = slots["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self.eps), {"moment": m}
+
+
+class Adam(Optimizer):
+    SLOTS = ("m", "v")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+        del lazy_mode  # sparse rows path not needed on TPU
+
+    def _apply(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self.b1 * slots["m"] + (1 - self.b1) * g32
+        v = self.b2 * slots["v"] + (1 - self.b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self.eps)
+        return (p - upd.astype(p.dtype)), {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (the BERT recipe optimizer).
+
+    ``decay_mask_fn(params) -> bool pytree`` selects which params decay
+    (standard recipes exclude biases and LayerNorm scales)."""
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01,
+                 decay_mask_fn: Optional[Callable] = None, **kw):
+        super().__init__(learning_rate, **kw)
+        self.wd = weight_decay
+        self.decay_mask_fn = decay_mask_fn
+
+    def update(self, grads, state, params, mask=None):
+        new_params, st = super().update(grads, state, params, mask)
+        if self.wd:
+            lr = self._lr(st["step"])
+            decay_mask = (self.decay_mask_fn(params) if self.decay_mask_fn
+                          else tmap(lambda _: True, params))
+            if mask is not None:  # never decay frozen params
+                decay_mask = tmap(lambda d, m: bool(d) and bool(m),
+                                  decay_mask, mask)
+            new_params = tmap(
+                lambda np_, p, d: np_ - lr * self.wd * p if d else np_,
+                new_params, params, decay_mask)
+        return new_params, st
+
+    def _apply(self, g, p, slots, lr, step):
+        return super()._apply(g, p, slots, lr, step)
+
+
+class Adamax(Optimizer):
+    SLOTS = ("m", "inf")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def _apply(self, g, p, slots, lr, step):
+        m = self.b1 * slots["m"] + (1 - self.b1) * g
+        u = jnp.maximum(self.b2 * slots["inf"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        upd = lr / (1 - self.b1 ** t) * m / (u + self.eps)
+        return p - upd.astype(p.dtype), {"m": m, "inf": u}
+
+
+class DecayedAdagrad(Optimizer):
+    SLOTS = ("moment",)
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.eps = decay, epsilon
+
+    def _apply(self, g, p, slots, lr, step):
+        m = self.decay * slots["moment"] + (1 - self.decay) * jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self.eps), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    SLOTS = ("avg_sq_grad", "avg_sq_update")
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self.eps, self.rho = epsilon, rho
+
+    def _apply(self, g, p, slots, lr, step):
+        asg = self.rho * slots["avg_sq_grad"] + (1 - self.rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_sq_update"] + self.eps) / jnp.sqrt(asg + self.eps)
+        asu = self.rho * slots["avg_sq_update"] + (1 - self.rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    SLOTS = ("mean_square", "mean_grad", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.eps = rho, epsilon
+        self.mu, self.centered = momentum, centered
+
+    def _apply(self, g, p, slots, lr, step):
+        ms = self.rho * slots["mean_square"] + (1 - self.rho) * jnp.square(g)
+        if self.centered:
+            mg = self.rho * slots["mean_grad"] + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + self.eps)
+        mom = self.mu * slots["momentum"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Ftrl(Optimizer):
+    SLOTS = ("squared", "linear")
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def _apply(self, g, p, slots, lr, step):
+        sq, lin = slots["squared"], slots["linear"]
+        new_sq = sq + jnp.square(g)
+        if self.lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+        else:
+            sigma = (new_sq ** -self.lr_power - sq ** -self.lr_power) / lr
+        new_lin = lin + g - sigma * p
+        if self.lr_power == -0.5:
+            denom = jnp.sqrt(new_sq) / lr + 2 * self.l2
+        else:
+            denom = new_sq ** -self.lr_power / lr + 2 * self.l2
+        pre = jnp.clip(new_lin, -self.l1, self.l1) - new_lin
+        return pre / denom, {"squared": new_sq, "linear": new_lin}
+
+
+class Lamb(Optimizer):
+    """LAMB (fluid LambOptimizer:2244) — large-batch BERT optimizer."""
+
+    SLOTS = ("m", "v")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.wd, self.b1, self.b2, self.eps = lamb_weight_decay, beta1, beta2, epsilon
+
+    def _apply(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self.b1 * slots["m"] + (1 - self.b1) * g32
+        v = self.b2 * slots["v"] + (1 - self.b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self.eps) + self.wd * p.astype(jnp.float32)
+        pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+        return (p - (lr * trust * r).astype(p.dtype)), {"m": m, "v": v}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (fluid DpsgdOptimizer:1647): clip + noise.
+    Needs an explicit PRNG key threaded through state."""
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16, sigma=1.0,
+                 seed=0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.clip_v, self.batch, self.sigma = clip, batch_size, sigma
+        self.seed = seed
+
+    def init(self, params):
+        st = super().init(params)
+        st["key"] = jax.random.PRNGKey(self.seed)
+        return st
+
+    def update(self, grads, state, params, mask=None):
+        key, sub = jax.random.split(state["key"])
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        keys = jax.random.split(sub, len(leaves))
+        noisy = [jnp.clip(g, -self.clip_v, self.clip_v)
+                 + self.sigma * self.clip_v / self.batch * jax.random.normal(k, g.shape)
+                 for g, k in zip(leaves, keys)]
+        grads = jax.tree_util.tree_unflatten(treedef, noisy)
+        params, st = super().update(grads, {k: v for k, v in state.items()
+                                            if k != "key"}, params, mask)
+        st["key"] = key
+        return params, st
+
+    def _apply(self, g, p, slots, lr, step):
+        return p - lr * g, slots
+
+
+# -- wrapper optimizers ----------------------------------------------------
+
+class LookaheadOptimizer:
+    """k-step lookahead (fluid LookaheadOptimizer:3517): slow weights pulled
+    toward fast weights every k steps."""
+
+    def __init__(self, inner: Optimizer, alpha=0.5, k=5):
+        self.inner, self.alpha, self.k = inner, alpha, k
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "slow": tmap(jnp.asarray, params)}
+
+    def update(self, grads, state, params, mask=None):
+        params, inner_st = self.inner.update(grads, state["inner"], params, mask)
+        step = inner_st["step"]
+        sync = (step % self.k) == 0
+        slow = tmap(lambda s, p: jnp.where(sync, s + self.alpha * (p - s), s),
+                    state["slow"], params)
+        params = tmap(lambda s, p: jnp.where(sync, s, p), slow, params)
+        return params, {"inner": inner_st, "slow": slow}
+
+
+class ExponentialMovingAverage:
+    """Param EMA for eval (fluid ExponentialMovingAverage:2701)."""
+
+    def __init__(self, decay=0.999):
+        self.decay = decay
+
+    def init(self, params):
+        return {"ema": tmap(jnp.asarray, params), "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, state, params):
+        step = state["step"] + 1
+        # Reference thresholds decay by (1+step)/(10+step) for early steps.
+        d = jnp.minimum(self.decay, (1.0 + step) / (10.0 + step))
+        ema = tmap(lambda e, p: d * e + (1 - d) * p, state["ema"], params)
+        return {"ema": ema, "step": step}
+
+    def apply(self, state):
+        return state["ema"]
+
+
+class ModelAverage:
+    """Sliding-window param average (fluid ModelAverage:2399)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000):
+        self.max_window = max_average_window
+
+    def init(self, params):
+        return {"sum": _zeros_like_tree(params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, state, params):
+        return {"sum": tmap(jnp.add, state["sum"], params),
+                "count": state["count"] + 1}
+
+    def apply(self, state):
+        c = jnp.maximum(state["count"], 1).astype(jnp.float32)
+        return tmap(lambda s: s / c, state["sum"])
+
+
+def recompute(fn, policy=None):
+    """Activation recomputation (fluid RecomputeOptimizer:3224 /
+    ``_append_backward_ops_with_checkpoints_`` backward.py:576) — on TPU this
+    is jax.checkpoint; apply to the model's forward or to each block."""
+    import functools
+    return jax.checkpoint(fn, policy=policy) if policy is not None \
+        else jax.checkpoint(fn)
+
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+DecayedAdagradOptimizer = DecayedAdagrad
+LarsMomentumOptimizer = LarsMomentum

@@ -1,0 +1,56 @@
+"""Gradient clipping (parity: ``python/paddle/fluid/clip.py`` —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GradClipBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class GradientClipByNorm(GradClipBase):
+    """Per-tensor L2 clip (clip.py GradientClipByNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree_util.tree_map(clip, grads)
+
+
+class GradientClipByGlobalNorm(GradClipBase):
+    """Global-norm clip over the whole grad tree (clip.py
+    GradientClipByGlobalNorm) — the BERT/Transformer standard."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def global_norm(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))

@@ -1,0 +1,106 @@
+"""Communication-reducing training algorithms: DGC, LocalSGD.
+
+Reference mapping (SURVEY.md §2.6):
+- DGC (deep gradient compression): ``DGCMomentumOptimizer`` optimizer.py:825
+  + ``dgc_op.cc`` top-k sparsify + ``SparseAllReduceOpHandle``
+  (details/sparse_all_reduce_op_handle.h:30 — allgather of encoded grads).
+  TPU-native: the *algorithm* (momentum correction + error feedback +
+  top-k sparsification) is a pure gradient transform; the wire-encoding
+  part is XLA's business (sparsified tensors all-reduce as dense over ICI,
+  which on TPU is usually faster than gather-of-indices anyway — the
+  algorithmic benefit that remains is DGC's large-batch convergence
+  behavior, and the transform keeps exact DGC semantics).
+- LocalSGD: ``transpiler/collective.py:269`` — per-worker local steps +
+  periodic param averaging. Expressed here for the shard_map training mode
+  where per-device params actually diverge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class DGC:
+    """Deep-gradient-compression transform with momentum correction.
+
+    state per param: u (momentum), v (error accumulation). Per step:
+        u = m*u + g ; v = v + u ; mask = top-k(|v|) ; out = v*mask ;
+        v = v*(1-mask) ; u = u*(1-mask)
+    ``sparsity``: fraction dropped (reference default ramps to 0.999).
+    """
+
+    def __init__(self, momentum: float = 0.9, sparsity: float = 0.9,
+                 rampup_steps: int = 0):
+        self.momentum = momentum
+        self.sparsity = sparsity
+        self.rampup_steps = rampup_steps
+
+    def init(self, params):
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"u": zeros(), "v": zeros(),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _sparsity_at(self, step):
+        if self.rampup_steps <= 0:
+            return self.sparsity
+        frac = jnp.minimum(step.astype(jnp.float32) / self.rampup_steps, 1.0)
+        # warmup from 75% toward target (reference ramps 0.75->0.999)
+        return 0.75 + (self.sparsity - 0.75) * frac
+
+    def transform(self, grads, state):
+        """-> (sparsified_grads, new_state)."""
+        sp = self._sparsity_at(state["step"])
+
+        def one(g, u, v):
+            u2 = self.momentum * u + g
+            v2 = v + u2
+            flat = jnp.abs(v2).reshape(-1)
+            n = flat.shape[0]
+            if n <= 1:
+                return v2, jnp.zeros_like(u2), jnp.zeros_like(v2)
+            # threshold at the sparsity quantile of |v|
+            thr = jnp.quantile(flat, sp)
+            mask = (jnp.abs(v2) > thr).astype(g.dtype)
+            out = v2 * mask
+            return out, u2 * (1 - mask), v2 * (1 - mask)
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_u = treedef.flatten_up_to(state["u"])
+        flat_v = treedef.flatten_up_to(state["v"])
+        outs, new_u, new_v = [], [], []
+        for g, u, v in zip(flat_g, flat_u, flat_v):
+            o, u2, v2 = one(g, u, v)
+            outs.append(o)
+            new_u.append(u2)
+            new_v.append(v2)
+        unflat = treedef.unflatten
+        return unflat(outs), {"u": unflat(new_u), "v": unflat(new_v),
+                              "step": state["step"] + 1}
+
+
+def localsgd_average(params, axis="dp"):
+    """Average per-device params over ``axis`` (LocalSGD sync point).
+    Call inside a shard_map-based train loop every k steps."""
+    return jax.tree_util.tree_map(
+        lambda p: jax.lax.pmean(p, axis), params)
+
+
+class LocalSGD:
+    """Periodic-averaging schedule helper: ``maybe_average(params, step)``
+    averages every k_steps inside a shard_map context."""
+
+    def __init__(self, k_steps: int = 4, axis: str = "dp"):
+        self.k_steps = k_steps
+        self.axis = axis
+
+    def maybe_average(self, params, step):
+        do = (step % self.k_steps) == 0
+
+        def avg(p):
+            m = jax.lax.pmean(p, self.axis)
+            return jnp.where(do, m, p)
+
+        return jax.tree_util.tree_map(avg, params)

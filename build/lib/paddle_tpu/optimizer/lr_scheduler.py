@@ -1,0 +1,109 @@
+"""Learning-rate schedules (parity: ``python/paddle/fluid/layers/
+learning_rate_scheduler.py`` — noam/exponential/natural_exp/inverse_time/
+polynomial/piecewise/cosine/warmup).
+
+Each schedule is a pure ``step -> lr`` callable, usable inside jit (step is a
+traced int array). The reference builds these as graph ops mutating a global
+lr Variable; here the step is just an argument.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def constant(value):
+    def sched(step):
+        del step
+        return jnp.asarray(value, jnp.float32)
+    return sched
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    def sched(step):
+        s = jnp.maximum(step.astype(jnp.float32) if hasattr(step, "astype")
+                        else jnp.asarray(step, jnp.float32), 1.0)
+        return learning_rate * d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * warmup_steps ** -1.5)
+    return sched
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    def sched(step):
+        e = jnp.asarray(step, jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * decay_rate ** e
+    return sched
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    def sched(step):
+        e = jnp.asarray(step, jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * jnp.exp(-decay_rate * e)
+    return sched
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    def sched(step):
+        e = jnp.asarray(step, jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate / (1.0 + decay_rate * e)
+    return sched
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        if cycle:
+            mult = jnp.maximum(1.0, jnp.ceil(s / decay_steps))
+            ds = decay_steps * mult
+        else:
+            ds = decay_steps
+            s = jnp.minimum(s, decay_steps)
+        return (learning_rate - end_learning_rate) * (1 - s / ds) ** power \
+            + end_learning_rate
+    return sched
+
+
+def piecewise_decay(boundaries, values):
+    boundaries = np.asarray(boundaries)
+    values = np.asarray(values, np.float32)
+
+    def sched(step):
+        idx = jnp.searchsorted(jnp.asarray(boundaries), jnp.asarray(step),
+                               side="right")
+        return jnp.asarray(values)[idx]
+    return sched
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    def sched(step):
+        epoch = jnp.floor(jnp.asarray(step, jnp.float32) / step_each_epoch)
+        return learning_rate * 0.5 * (jnp.cos(epoch * np.pi / epochs) + 1)
+    return sched
+
+
+def cosine_decay_steps(learning_rate, total_steps, end_lr=0.0):
+    """Continuous cosine over steps (modern variant for BERT/ResNet recipes)."""
+    def sched(step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / total_steps, 0.0, 1.0)
+        return end_lr + (learning_rate - end_lr) * 0.5 * (1 + jnp.cos(np.pi * frac))
+    return sched
+
+
+def linear_lr_warmup(base_sched, warmup_steps, start_lr, end_lr):
+    """Wrap another schedule with linear warmup (fluid linear_lr_warmup)."""
+    if not callable(base_sched):
+        base_sched = constant(base_sched)
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = start_lr + (end_lr - start_lr) * jnp.minimum(s, warmup_steps) / warmup_steps
+        return jnp.where(s < warmup_steps, warm, base_sched(step))
+    return sched

@@ -1,0 +1,26 @@
+"""Weight-decay regularizers (parity: ``python/paddle/fluid/regularizer.py``
+L1Decay/L2Decay — the reference appends regularization ops to each param's
+grad; here they are grad transforms)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class L2Decay:
+    def __init__(self, coeff):
+        self.coeff = coeff
+
+    def __call__(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * p, grads, params)
+
+
+class L1Decay:
+    def __init__(self, coeff):
+        self.coeff = coeff
+
+    def __call__(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * jnp.sign(p), grads, params)

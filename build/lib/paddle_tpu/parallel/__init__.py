@@ -1,0 +1,32 @@
+"""Parallelism subsystem: sharding plans, pjit wrappers, explicit collectives.
+
+Replaces the reference's multi-device world — ParallelExecutor + SSA graph
+builders + NCCL op handles + DistributeTranspiler (SURVEY.md §2.6, §3.2) —
+with mesh-and-sharding declarations compiled by XLA GSPMD.
+"""
+
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.api import (batch_specs, shard_eval_step,
+                                     shard_train_step,
+                                     with_sharding_constraint)
+from paddle_tpu.parallel.embedding import (ShardedEmbedding,
+                                           vocab_parallel_lookup)
+from paddle_tpu.parallel.plan import (Rule, ShardingPlan, fsdp_plan,
+                                      megatron_plan, named_shardings,
+                                      replicated_plan)
+from paddle_tpu.parallel.pipeline import (circular_pipeline, gpipe,
+                                          interleave_stack, microbatch,
+                                          pipeline_bubble_fraction,
+                                          stack_layer_params,
+                                          uninterleave_stack, unmicrobatch)
+from paddle_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "collective", "batch_specs", "shard_eval_step", "shard_train_step",
+    "with_sharding_constraint", "Rule", "ShardingPlan", "fsdp_plan",
+    "megatron_plan", "named_shardings", "replicated_plan",
+    "ShardedEmbedding", "vocab_parallel_lookup", "ring_attention",
+    "gpipe", "circular_pipeline", "pipeline_bubble_fraction",
+    "interleave_stack", "uninterleave_stack",
+    "microbatch", "stack_layer_params", "unmicrobatch",
+]

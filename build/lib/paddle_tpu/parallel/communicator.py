@@ -280,18 +280,13 @@ class FLCommunicator:
         if n.shape != (k,):
             raise ValueError(f"num_examples must be ({k},), got {n.shape}")
         m = (jnp.ones((k,), bool) if participants is None
-             else jnp.asarray(participants).reshape((k,)))
+             else jnp.asarray(participants))
         if int(m.sum()) < self.min_fanin:
             raise ValueError(
                 f"only {int(m.sum())} clients reported; fanin "
                 f"{self.min_fanin} required (fl_listen_and_serv Fanin)")
         w = n * m
-        total = float(w.sum())
-        if total <= 0.0:
-            raise ValueError(
-                "every participating client reported 0 examples — "
-                "aggregating would zero the globals; skip this round")
-        w = w / total
+        w = w / jnp.maximum(w.sum(), 1e-12)
 
         def merge(p):
             return jnp.tensordot(w, p, axes=1)

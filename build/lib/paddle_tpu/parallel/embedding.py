@@ -1,0 +1,114 @@
+"""Sharded embedding engine: the TPU-native parameter-server replacement.
+
+Reference mapping (SURVEY.md §5.8 "PS/gRPC world"): fluid serves massive
+sparse embeddings through a parameter server — ``lookup_table_op`` with
+``SelectedRows`` sparse grads, ``distributed_lookup_table_op``/
+``parameter_prefetch.cc`` remote lookups, pslib KV store via
+``FleetWrapper::PullSparseVarsSync`` (``fleet_wrapper.h:76``). On TPU the
+table is GSPMD-sharded over a mesh axis and the "prefetch RPC" becomes an
+on-chip collective:
+
+- rows sharded over "tp"/"ep" (Megatron vocab-parallel): each device masks
+  ids to its row range, gathers locally, and a psum merges partials — one
+  all-reduce instead of a pserver round trip.
+- gradients flow through ``jnp.take`` (XLA scatter-add on the backward) —
+  the ``SelectedRows`` sparse-grad machinery is subsumed by XLA.
+
+For tables beyond aggregate HBM, the host-resident KV engine
+(``paddle_tpu/parallel/host_kv.py`` over ``native/kv_store.cc``) holds the
+table in host memory and the device step consumes pulled rows — see
+:func:`paddle_tpu.parallel.host_kv.fits_hbm` for the placement policy
+(SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import Layer
+
+
+def vocab_parallel_lookup(ids, table, *, axis: str = mesh_lib.TP,
+                          mesh: Optional[Mesh] = None):
+    """Megatron-style sharded lookup: ``table`` rows sharded over ``axis``.
+
+    ids: int array (any shape); table: (V, D) with V sharded. Returns
+    embeddings of shape ids.shape + (D,), replicated over ``axis``.
+    Under jit+mesh, GSPMD sees an explicit shard_map: local masked take +
+    psum (≙ the pserver prefetch+merge round, parameter_prefetch.cc).
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        # single-device / no-mesh: plain lookup
+        return jnp.take(table, ids, axis=0)
+
+    def body(ids, table):
+        n = jax.lax.axis_size(axis)
+        shard_rows = table.shape[0]
+        start = jax.lax.axis_index(axis) * shard_rows
+        local = ids - start
+        in_range = (local >= 0) & (local < shard_rows)
+        safe = jnp.clip(local, 0, shard_rows - 1)
+        out = jnp.take(table, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0.0)
+        if n > 1:
+            out = jax.lax.psum(out, axis)
+        return out
+
+    batch_size = mesh.shape["dp"] * mesh.shape["fsdp"] \
+        if all(a in mesh.shape for a in mesh_lib.BATCH_AXES) else 1
+    if ids.ndim and batch_size > 1 and ids.shape[0] % batch_size == 0:
+        ids_spec = P(mesh_lib.BATCH_AXES)
+    else:  # odd batch (or scalar ids): keep ids replicated
+        ids_spec = P()
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(ids_spec, P(axis, None)),
+        out_specs=ids_spec,
+        check_vma=False,
+    )(ids, table)
+
+
+class ShardedEmbedding(Layer):
+    """Embedding with rows sharded over a mesh axis; lookup via
+    :func:`vocab_parallel_lookup` when a mesh is active.
+
+    ``combiner``: None returns (..., num_ids, D); "sum"/"mean" reduce over
+    the ids dim (fluid ``embedding`` + ``sequence_pool`` fusion — the
+    MultiSlot CTR pattern, data_feed.h MultiSlot slots)."""
+
+    def __init__(self, num_embeddings, embedding_dim, *, axis=mesh_lib.TP,
+                 combiner: Optional[str] = None, weight_init=None,
+                 padding_idx: Optional[int] = None):
+        super().__init__()
+        self.axis = axis
+        self.combiner = combiner
+        self.padding_idx = padding_idx
+        self.num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            "weight", (num_embeddings, embedding_dim),
+            initializer=weight_init or I.normal(0.0, 0.01),
+            sharding=P(axis, None))
+
+    def forward(self, params, ids):
+        out = vocab_parallel_lookup(ids, params["weight"], axis=self.axis)
+        if self.padding_idx is not None:
+            valid = ids != self.padding_idx
+            out = jnp.where(valid[..., None], out, 0.0)
+        if self.combiner == "sum":
+            out = out.sum(axis=-2)
+        elif self.combiner == "mean":
+            if self.padding_idx is not None:
+                # mean over VALID ids only (sequence_pool "average" parity)
+                denom = jnp.maximum(
+                    valid.sum(axis=-1, keepdims=True), 1).astype(out.dtype)
+                out = out.sum(axis=-2) / denom
+            else:
+                out = out.mean(axis=-2)
+        return out

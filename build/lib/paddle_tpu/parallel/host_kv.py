@@ -1,0 +1,363 @@
+"""Host-resident KV embedding: the parameter-server world, TPU-native.
+
+Reference mapping: fluid's sparse tables live in pserver processes and the
+trainer pulls/pushes rows over RPC (``FleetWrapper::PullSparseVarsSync``
+fleet_wrapper.h:76, ``PushSparsePush``/``PushDenseVarsAsync`` :96;
+``listen_and_serv_op.cc:110``; async merge via ``communicator.h:166``). On
+TPU the beyond-HBM table lives in HOST memory (paddle_tpu/native/
+kv_store.cc): the device step only sees the gathered rows for the current
+batch, so the "RPC" is a host hash lookup + a few-MB host→HBM copy that a
+prefetch thread overlaps with the previous device step.
+
+Pipeline per batch (sync mode):
+  uniq, inv = np.unique(feat_ids)            # host dedup
+  rows = store.pull(uniq)                    # host KV gather (C++ threads)
+  ...device: emb = rows[inv]; grads w.r.t. rows arrive via XLA scatter-add
+  store.push(uniq, grad_rows, lr)            # host sparse optimizer
+
+Async mode: ``prefetch_batch`` starts the pull for batch N+1 while batch N
+runs on device; ``apply_grads(..., wait=False)`` applies the push on
+background threads (hogwild-delayed, the AsyncCommunicator analog).
+
+The number of unique ids varies per batch; ``rows`` is padded to a bucketed
+size so the jitted train step compiles O(log U_max) times, not per batch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu import native
+
+OPT_SGD = 0
+OPT_ADAGRAD = 1
+_OPT_NAMES = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD}
+
+
+def _lib():
+    lib = native.load_library("kvstore", ["kv_store.cc"])
+    lib.kv_create.restype = ctypes.c_void_p
+    lib.kv_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_float,
+                              ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.kv_destroy.argtypes = [ctypes.c_void_p]
+    P_I64 = ctypes.POINTER(ctypes.c_int64)
+    P_F32 = ctypes.POINTER(ctypes.c_float)
+    lib.kv_pull.argtypes = [ctypes.c_void_p, P_I64, ctypes.c_int64, P_F32]
+    lib.kv_pull_async.restype = ctypes.c_int64
+    lib.kv_pull_async.argtypes = [ctypes.c_void_p, P_I64, ctypes.c_int64,
+                                  P_F32]
+    lib.kv_push.argtypes = [ctypes.c_void_p, P_I64, ctypes.c_int64, P_F32,
+                            ctypes.c_float]
+    lib.kv_push_async.restype = ctypes.c_int64
+    lib.kv_push_async.argtypes = [ctypes.c_void_p, P_I64, ctypes.c_int64,
+                                  P_F32, ctypes.c_float]
+    lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.kv_flush.argtypes = [ctypes.c_void_p]
+    lib.kv_set_rows.argtypes = [ctypes.c_void_p, P_I64, ctypes.c_int64,
+                                P_F32]
+    lib.kv_size.restype = ctypes.c_int64
+    lib.kv_size.argtypes = [ctypes.c_void_p]
+    lib.kv_save.restype = ctypes.c_int
+    lib.kv_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.kv_load.restype = ctypes.c_int
+    lib.kv_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return lib
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class HostKVStore:
+    """ctypes handle over the native sharded KV table.
+
+    ``dim`` is the row width visible to the model (optimizer slot state is
+    held natively alongside, invisible here). Rows materialize lazily on
+    first pull with deterministic per-id init (uniform ±init_scale).
+    """
+
+    def __init__(self, dim: int, *, optimizer: str = "adagrad",
+                 init_scale: float = 0.01, seed: int = 0,
+                 num_shards: int = 64, num_threads: int = 8):
+        self._lib = _lib()
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self._h = self._lib.kv_create(
+            self.dim, _OPT_NAMES[optimizer], float(init_scale), int(seed),
+            int(num_shards), int(num_threads))
+        if not self._h:
+            raise RuntimeError("kv_create failed")
+
+    def pull(self, ids: np.ndarray, out: Optional[np.ndarray] = None
+             ) -> np.ndarray:
+        """Gather rows for ``ids``. ``out`` (if given) must be a C-contiguous
+        float32 array with at least ids.size rows; rows are written into its
+        leading slice (lets callers pull straight into a padded buffer)."""
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        if out is None:
+            out = np.empty((ids.size, self.dim), np.float32)
+        else:
+            self._check_out(ids, out)
+        self._lib.kv_pull(self._h, _i64p(ids), ids.size, _f32p(out))
+        return out[:ids.size]
+
+    def pull_async(self, ids: np.ndarray,
+                   out: Optional[np.ndarray] = None) -> "PullHandle":
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        if out is None:
+            out = np.empty((ids.size, self.dim), np.float32)
+        else:
+            self._check_out(ids, out)
+        ticket = self._lib.kv_pull_async(self._h, _i64p(ids), ids.size,
+                                         _f32p(out))
+        return PullHandle(self, ticket, ids, out)
+
+    def _check_out(self, ids, out):
+        if (out.dtype != np.float32 or not out.flags.c_contiguous
+                or out.ndim != 2 or out.shape[0] < ids.size
+                or out.shape[1] != self.dim):
+            raise ValueError(
+                f"out buffer must be C-contiguous float32 (>= {ids.size},"
+                f" {self.dim}); got {out.dtype} {out.shape}")
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float,
+             wait: bool = True):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32)
+        if grads.shape != (ids.size, self.dim):
+            raise ValueError(f"grads shape {grads.shape} != "
+                             f"({ids.size}, {self.dim})")
+        if wait:
+            self._lib.kv_push(self._h, _i64p(ids), ids.size, _f32p(grads),
+                              float(lr))
+        else:
+            # native copies the buffers; applied by pool threads
+            self._lib.kv_push_async(self._h, _i64p(ids), ids.size,
+                                    _f32p(grads), float(lr))
+
+    def set_rows(self, ids: np.ndarray, vals: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        vals = np.ascontiguousarray(vals, np.float32)
+        if vals.shape != (ids.size, self.dim):
+            raise ValueError(f"vals shape {vals.shape} != "
+                             f"({ids.size}, {self.dim})")
+        self._lib.kv_set_rows(self._h, _i64p(ids), ids.size, _f32p(vals))
+
+    def flush(self):
+        """Barrier for all outstanding async pulls/pushes."""
+        self._lib.kv_flush(self._h)
+
+    def __len__(self):
+        return int(self._lib.kv_size(self._h))
+
+    def save(self, path: str):
+        self.flush()
+        if self._lib.kv_save(self._h, str(path).encode()) != 0:
+            raise IOError(f"kv_save({path}) failed")
+
+    def load(self, path: str):
+        if self._lib.kv_load(self._h, str(path).encode()) != 0:
+            raise IOError(f"kv_load({path}) failed (dim/optimizer mismatch "
+                          "or unreadable file)")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.kv_flush(h)
+            self._lib.kv_destroy(h)
+            self._h = None
+
+
+class PullHandle:
+    """An in-flight async pull; buffers are pinned here until wait().
+
+    The native pool writes into ``ids``/``out`` directly, so an abandoned
+    handle must still wait before the buffers are garbage-collected —
+    ``__del__`` guarantees that (pushes copy their inputs; pulls do not).
+    """
+
+    def __init__(self, store: HostKVStore, ticket: int, ids, out):
+        self._store, self._ticket = store, ticket
+        self._ids, self._out = ids, out
+        self._done = False
+
+    def wait(self) -> np.ndarray:
+        if not self._done:
+            self._store._lib.kv_wait(self._store._h, self._ticket)
+            self._done = True
+        return self._out
+
+    def __del__(self):
+        try:
+            self.wait()
+        except Exception:
+            pass  # store already torn down
+
+
+class SparseBatch(NamedTuple):
+    """Device-ready view of one batch's sparse rows.
+
+    rows[inv] reconstructs the per-feature embeddings; ``uniq`` is padded
+    with -1 (rows zero-padded) to a bucketed size for a bounded number of
+    jit compilations.
+    """
+    uniq: np.ndarray   # (U_pad,) int64, -1 padding
+    rows: np.ndarray   # (U_pad, dim) float32
+    inv: np.ndarray    # feat_ids.shape int32 indices into rows
+
+
+def _bucket(n: int, minimum: int) -> int:
+    b = max(minimum, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+class HostKVEmbedding:
+    """Batch-level orchestration over :class:`HostKVStore`.
+
+    The model-side contract: the jitted step takes ``rows`` (U_pad, dim)
+    as a differentiable input and ``inv`` as indices; its grad w.r.t.
+    ``rows`` (XLA scatter-add over the gather) is what ``apply_grads``
+    pushes back. lr lives host-side (sparse optimizer runs on host).
+    """
+
+    def __init__(self, store: HostKVStore, *, lr: float = 0.01,
+                 min_bucket: int = 256):
+        self.store = store
+        self.lr = lr
+        self.min_bucket = min_bucket
+
+    # -- pulls ---------------------------------------------------------------
+    def _dedup(self, feat_ids: np.ndarray):
+        uniq, inv = np.unique(np.asarray(feat_ids, np.int64),
+                              return_inverse=True)
+        pad = _bucket(uniq.size, self.min_bucket)
+        uniq_p = np.full((pad,), -1, np.int64)
+        uniq_p[:uniq.size] = uniq
+        return uniq, uniq_p, inv.reshape(feat_ids.shape).astype(np.int32)
+
+    def lookup_batch(self, feat_ids: np.ndarray) -> SparseBatch:
+        uniq, uniq_p, inv = self._dedup(feat_ids)
+        rows = np.zeros((uniq_p.size, self.store.dim), np.float32)
+        self.store.pull(uniq, out=rows)   # fills rows[:U] in place
+        return SparseBatch(uniq_p, rows, inv)
+
+    def prefetch_batch(self, feat_ids: np.ndarray) -> "SparsePrefetch":
+        uniq, uniq_p, inv = self._dedup(feat_ids)
+        rows = np.zeros((uniq_p.size, self.store.dim), np.float32)
+        return SparsePrefetch(self.store.pull_async(uniq, out=rows),
+                              uniq_p, inv)
+
+    # -- push ----------------------------------------------------------------
+    def apply_grads(self, batch: SparseBatch, grad_rows, *,
+                    wait: bool = True):
+        grad_rows = np.asarray(grad_rows, np.float32)
+        real = batch.uniq >= 0
+        self.store.push(batch.uniq[real], grad_rows[real], self.lr,
+                        wait=wait)
+
+    def flush(self):
+        self.store.flush()
+
+
+class SparsePrefetch:
+    """In-flight pull straight into the padded rows buffer."""
+
+    def __init__(self, handle: PullHandle, uniq_p, inv):
+        self._handle, self._uniq_p, self._inv = handle, uniq_p, inv
+
+    def wait(self) -> SparseBatch:
+        self._handle.wait()
+        return SparseBatch(self._uniq_p, self._handle._out, self._inv)
+
+
+def fits_hbm(vocab_size: int, dim: int, *, budget_bytes: int,
+             dtype_bytes: int = 4, optimizer_slots: int = 2) -> bool:
+    """Placement policy: a table (plus device optimizer state) must fit the
+    per-table HBM budget to be GSPMD-sharded on chip; otherwise it goes to
+    the host KV world (the pslib beyond-HBM case)."""
+    return vocab_size * dim * dtype_bytes * (1 + optimizer_slots) \
+        <= budget_bytes
+
+
+def build_kv_train_step(loss_fn, optimizer):
+    """Train step for models with host-resident sparse tables.
+
+    ``loss_fn(params, rows, **batch)`` -> scalar or (scalar, aux); ``rows``
+    is the pulled (U_pad, dim) array. Returns ``step(state, rows, **batch)
+    -> (state, grad_rows, metrics)`` — dense params update on device (the
+    hogwild "dense vars" path), ``grad_rows`` goes back to the host store.
+    Jit it once; compile count is bounded by the row-bucket count.
+    """
+    import jax
+
+    def forward(params, rows, batch):
+        out = loss_fn(params, rows, **batch)
+        if isinstance(out, tuple):
+            return out
+        return out, {}
+
+    grad_fn = jax.value_and_grad(forward, argnums=(0, 1), has_aux=True)
+
+    def step(state, rows, **batch):
+        (loss, aux), (grads, grad_rows) = grad_fn(
+            state["params"], rows, batch)
+        params, opt_state = optimizer.update(
+            grads, state["opt"], state["params"])
+        new_state = dict(state)
+        new_state.update(params=params, opt=opt_state,
+                         step=state["step"] + 1)
+        return new_state, grad_rows, {"loss": loss, **aux}
+
+    return step
+
+
+def run_kv_epoch(step_fn, state, emb: HostKVEmbedding, batches,
+                 ids_key: str = "feat_ids", *, prefetch: bool = True,
+                 async_push: bool = False):
+    """Drive one epoch of host-KV training.
+
+    ``prefetch=True`` pulls batch i+1's rows (C++ threads, no GIL) while
+    batch i runs on device — the parameter-prefetch overlap of the
+    reference's DownpourWorker pipeline. ``async_push=True`` applies
+    gradient pushes on background threads (delayed/hogwild updates, the
+    AsyncCommunicator mode); reads may then be one batch stale — exactly
+    the reference's async semantics. Use prefetch=False, async_push=False
+    for strictly synchronous (parity-testable) training.
+
+    ``batches`` yields dicts; ``batch[ids_key]`` are the sparse feature
+    ids, every other key is fed to ``step_fn``.
+    """
+    import numpy as _np
+
+    history = []
+    it = iter(batches)
+    batch = next(it, None)
+    pf = None
+    while batch is not None:
+        nxt = next(it, None) if prefetch else None
+        if prefetch:
+            # this batch's pull was issued last iteration (or is the first)
+            sb = pf.wait() if pf is not None \
+                else emb.lookup_batch(batch[ids_key])
+            if nxt is not None:
+                pf = emb.prefetch_batch(nxt[ids_key])
+        else:
+            # strictly synchronous: pull AFTER the previous push landed
+            sb = emb.lookup_batch(batch[ids_key])
+        feed = {k: v for k, v in batch.items() if k != ids_key}
+        state, grad_rows, metrics = step_fn(
+            state, sb.rows, inv=sb.inv, **feed)
+        emb.apply_grads(sb, _np.asarray(grad_rows), wait=not async_push)
+        history.append(metrics)
+        batch = nxt if prefetch else next(it, None)
+    emb.flush()
+    return state, history

@@ -1,0 +1,452 @@
+"""Pipeline parallelism: GPipe schedule over the "pp" mesh axis.
+
+Reference mapping: fluid's pipeline is a runtime construct — the program is
+cut into sections, each run by a ``SectionWorker`` thread with scope-queues
+between stages (``PipelineOptimizer`` optimizer.py:2931, ``PipelineTrainer``
+trainer.h:113, ``SectionWorker`` device_worker.h:267). TPU-native: the
+schedule is *traced* — a fori_loop over M + n - 1 ticks inside a shard_map
+over "pp"; activations hop stages via ``lax.ppermute`` (ICI neighbor
+transfer), and autodiff through the loop yields the reverse pipeline, so
+one jitted train step contains the whole fwd+bwd schedule.
+
+Composition: the shard_map binds the FULL mesh, so the activation can stay
+sharded over (dp, fsdp) batch axes and the "sp" sequence axis via
+``x_spec`` while layers hop over "pp" (stage params are replicated over the
+other axes; their backward psums the grad contributions automatically).
+Per-microbatch side inputs (attention bias, the microbatch index for
+dropout PRNG folding) ride the ring alongside the activation.
+
+Constraint (same as scan-over-layers): pipelined blocks must be
+structurally identical — true for transformer stacks. Embedding/head run
+outside the pipelined middle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+
+
+def stack_layer_params(params_list):
+    """[{layer params}, ...] -> single pytree with stacked (L, ...) leaves
+    (the pipeline's weight layout; ≙ section programs per device)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params_list)
+
+
+def _get_at(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set_at(tree, path, value):
+    if not path:
+        return value
+    return {**tree, path[0]: _set_at(tree[path[0]], path[1:], value)}
+
+
+def stack_params_at(params, path, num_layers: int):
+    """Convert the LayerList-layout subtree at ``path`` (per-layer dicts
+    keyed "0".."L-1") into stacked (L, ...) leaves — checkpoint migration
+    into the StackedLayers layout. E.g. BERT: path=("bert", "encoder");
+    GPT: path=("blocks",)."""
+    node = _get_at(params, path)
+    stacked = stack_layer_params([node[str(i)] for i in range(num_layers)])
+    return _set_at(params, tuple(path), stacked)
+
+
+def unstack_params_at(params, path, num_layers: int):
+    """Inverse of :func:`stack_params_at`."""
+    node = _get_at(params, path)
+    per = {str(i): jax.tree_util.tree_map(lambda x: x[i], node)
+           for i in range(num_layers)}
+    return _set_at(params, tuple(path), per)
+
+
+def gpipe(
+    block_fn: Callable,
+    stacked_params: Any,
+    x_microbatches,
+    *,
+    extras: Any = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = mesh_lib.PP,
+    remat: bool = True,
+    x_spec: Optional[P] = None,
+    extras_spec: Any = None,
+):
+    """Run microbatches through a pipelined stack of identical blocks.
+
+    ``block_fn(layer_params, h, extra, mb_idx) -> h``; ``stacked_params``
+    leaves are (L_total, ...) with L_total divisible by the "pp" axis size;
+    ``x_microbatches``: (M, mb, ...) microbatched activations; ``extras``:
+    optional pytree of (M, ...) per-microbatch side inputs that travel the
+    ring with the activation (e.g. attention bias); ``mb_idx`` is the
+    traced int32 microbatch index (for dropout key folding).
+
+    ``x_spec``/``extras_spec``: PartitionSpecs for the (M, ...) arrays so
+    batch/sequence sharding over the other mesh axes is preserved inside
+    the pipeline (default: replicated). Returns (M, mb, ...) outputs
+    (replicated over "pp", sharded per ``x_spec`` elsewhere).
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("gpipe requires a mesh")
+    n = mesh.shape[axis]
+    M = x_microbatches.shape[0]
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+    x_spec = x_spec if x_spec is not None else P()
+    if extras_spec is None:
+        extras_spec = jax.tree_util.tree_map(lambda _: P(), extras)
+
+    def local_stage(local_params, h, extra, mb):
+        # apply this stage's L_total/n layers (scan over stacked leaves)
+        def body(h, layer_params):
+            return block_fn(layer_params, h, extra, mb), None
+        h, _ = jax.lax.scan(body, h, local_params)
+        return h
+
+    def stage_body(local_params, x, extras):
+        s = jax.lax.axis_index(axis)
+        is_first = s == 0
+        is_last = s == n - 1
+        T = M + n - 1
+        perm = [(i, i + 1) for i in range(n - 1)]
+        recv_h = jnp.zeros(x.shape[1:], x.dtype)
+        recv_e = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), extras)
+        recv_mb = jnp.zeros((), jnp.int32)
+        outputs = jnp.zeros_like(x)
+
+        def tick(t, carry):
+            (recv_h, recv_e, recv_mb), outputs = carry
+            feed_at = jnp.clip(t, 0, M - 1)
+            feed_h = jax.lax.dynamic_index_in_dim(x, feed_at, keepdims=False)
+            feed_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, feed_at,
+                                                       keepdims=False),
+                extras)
+            inp_h = jnp.where(is_first, feed_h, recv_h)
+            inp_e = jax.tree_util.tree_map(
+                lambda f, r: jnp.where(is_first, f, r), feed_e, recv_e)
+            inp_mb = jnp.where(is_first, feed_at, recv_mb)
+            h = local_stage(local_params, inp_h, inp_e, inp_mb)
+            mb_idx = t - s          # microbatch this stage just computed
+            active = (mb_idx >= 0) & (mb_idx < M)
+            write_at = jnp.clip(mb_idx, 0, M - 1)
+            outputs = _masked_row_update(outputs, write_at, h,
+                                         active & is_last)
+            recv_h = jax.lax.ppermute(h, axis, perm)
+            recv_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis, perm), inp_e)
+            recv_mb = jax.lax.ppermute(inp_mb, axis, perm)
+            return ((recv_h, recv_e, recv_mb), outputs)
+
+        _, outputs = jax.lax.fori_loop(
+            0, T, tick, ((recv_h, recv_e, recv_mb), outputs))
+        # outputs are only valid on the last stage: replicate via psum
+        outputs = jnp.where(is_last, outputs, 0.0)
+        return jax.lax.psum(outputs, axis)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    return jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(param_specs, x_spec, extras_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stacked_params, x_microbatches, extras)
+
+
+def _masked_row_update(buf, idx, row, pred):
+    prev = jax.lax.dynamic_index_in_dim(buf, idx, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(
+        buf, jnp.where(pred, row, prev), idx, 0)
+
+
+def interleave_stack(stacked_params, n_stages: int, num_circuits: int):
+    """Re-arrange stacked (L, ...) leaves from contiguous-stage order into
+    the circular schedule's interleaved placement, so that contiguous
+    P("pp") sharding hands device s chunks s, s+n, ..., s+(v-1)n (the
+    Megatron interleaved-1F1B assignment). Apply ONCE at param-layout
+    time (init / checkpoint load) and pass
+    ``circular_pipeline(..., pre_interleaved=True)``; arranging inside
+    the train step costs a cross-device reshuffle of every weight (and
+    its gradient) per step."""
+    n, v = n_stages, num_circuits
+
+    def arrange(a):
+        k = a.shape[0] // (n * v)
+        return a.reshape((v, n, k) + a.shape[1:]).swapaxes(0, 1).reshape(
+            (a.shape[0],) + a.shape[1:])
+
+    return jax.tree_util.tree_map(arrange, stacked_params)
+
+
+def uninterleave_stack(stacked_params, n_stages: int, num_circuits: int):
+    """Inverse of :func:`interleave_stack` (checkpoint export)."""
+    n, v = n_stages, num_circuits
+
+    def arrange(a):
+        k = a.shape[0] // (n * v)
+        return a.reshape((n, v, k) + a.shape[1:]).swapaxes(0, 1).reshape(
+            (a.shape[0],) + a.shape[1:])
+
+    return jax.tree_util.tree_map(arrange, stacked_params)
+
+
+def pipeline_bubble_fraction(n_stages: int, num_microbatches: int,
+                             num_circuits: int = 1) -> float:
+    """Fraction of stage-computations that are pipeline bubble.
+
+    The traced SPMD schedule executes every stage every tick, so waste is
+    structural: GPipe runs M + n - 1 ticks for M useful microbatch-passes
+    per stage -> (n-1)/(M+n-1). The circular schedule with v virtual
+    stage chunks per device runs v*M + n - 1 ticks of 1/v-size chunks ->
+    (n-1)/(v*M+n-1). (The reference's threaded SectionWorker 1F1B,
+    section_worker.cc:27, has the same (n-1)-slot bubble; its win is
+    concurrency across scopes, which SPMD tracing gets for free.)"""
+    n, M, v = n_stages, num_microbatches, num_circuits
+    return (n - 1) / (v * M + n - 1)
+
+
+def circular_pipeline(
+    block_fn: Callable,
+    stacked_params: Any,
+    x_microbatches,
+    *,
+    num_circuits: int,
+    extras: Any = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = mesh_lib.PP,
+    remat: bool = True,
+    x_spec: Optional[P] = None,
+    extras_spec: Any = None,
+    pre_interleaved: bool = False,
+):
+    """Interleaved (1F1B-circular) pipeline schedule: each device owns
+    ``num_circuits`` (v) non-adjacent chunks of the layer stack and every
+    microbatch rides the "pp" ring v times (device s holds layer chunks
+    s, s+n, ..., s+(v-1)n — the Megatron-LM interleaved-1F1B placement).
+
+    Dense timetable (requires M >= n): device s computes (circuit c,
+    microbatch m) at tick t = c*M + m + s; an item leaving the last stage
+    re-enters stage 0 after n ticks and waits in a slot buffer for its
+    next circuit. Total ticks v*M + n - 1 of 1/v-size chunks, so the
+    bubble fraction is (n-1)/(v*M+n-1) versus GPipe's (n-1)/(M+n-1) —
+    see :func:`pipeline_bubble_fraction`. Backward through the traced
+    loop reverses the same schedule, and only ~n chunk activations are
+    live per tick (1F1B's memory profile) instead of GPipe's M.
+
+    Same contract as :func:`gpipe` otherwise; ``stacked_params`` leaves
+    are (L, ...) with L divisible by n * num_circuits.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("circular_pipeline requires a mesh")
+    n = mesh.shape[axis]
+    v = num_circuits
+    M = x_microbatches.shape[0]
+    if M < n:
+        raise ValueError(
+            f"circular schedule needs microbatches >= pp stages "
+            f"(got M={M} < n={n}); use gpipe for short streams")
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if L % (n * v):
+        raise ValueError(f"layers {L} not divisible by pp*circuits "
+                         f"{n}*{v}")
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+    x_spec = x_spec if x_spec is not None else P()
+    if extras_spec is None:
+        extras_spec = jax.tree_util.tree_map(lambda _: P(), extras)
+
+    # contiguous P(axis) sharding must hand device s its v interleaved
+    # chunks in circuit order; pre-arrange at layout time when possible
+    # (pre_interleaved=True) to keep the weight reshuffle out of the step
+    k = L // (n * v)
+    arranged = (stacked_params if pre_interleaved else
+                interleave_stack(stacked_params, n, v))
+
+    def stage_body(local_params, x, extras):
+        # local_params leaves: (v*k, ...) -> (v, k, ...) chunks
+        local_params = jax.tree_util.tree_map(
+            lambda a: a.reshape((v, k) + a.shape[1:]), local_params)
+        s = jax.lax.axis_index(axis)
+        is_first = s == 0
+        T = v * M + n - 1
+        ring = [(i, (i + 1) % n) for i in range(n)]
+        zero_h = jnp.zeros(x.shape[1:], x.dtype)
+        zero_e = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), extras)
+        carry = dict(
+            recv_h=zero_h, recv_e=zero_e, recv_mb=jnp.zeros((), jnp.int32),
+            buf=jnp.zeros_like(x),        # stage-0 inter-circuit slots
+            outputs=jnp.zeros_like(x),
+        )
+
+        def chunk_apply(c, h, extra, mb):
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, keepdims=False),
+                local_params)
+
+            def body(h, layer_params):
+                return block_fn(layer_params, h, extra, mb), None
+            h, _ = jax.lax.scan(body, h, chunk)
+            return h
+
+        def tick(t, carry):
+            # -- stage 0: bank the arriving item (next circuit or output)
+            arr_t = t - n                      # item (c_in, slot) arriving
+            arr_valid = arr_t >= 0
+            slot = jnp.clip(arr_t, 0, v * M - 1) % M
+            c_in = jnp.clip(arr_t, 0, v * M - 1) // M
+            done = c_in == v - 1
+            put = is_first & arr_valid
+            buf = _masked_row_update(carry["buf"], slot,
+                                     carry["recv_h"], put & ~done)
+            outputs = _masked_row_update(carry["outputs"], slot,
+                                         carry["recv_h"], put & done)
+
+            # -- select this tick's input
+            c = jnp.clip(t, 0, v * M - 1) // M
+            m = jnp.clip(t, 0, v * M - 1) % M
+            feed_h = jnp.where(
+                c == 0,
+                jax.lax.dynamic_index_in_dim(x, m, keepdims=False),
+                jax.lax.dynamic_index_in_dim(buf, m, keepdims=False))
+            feed_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
+                extras)
+            inp_h = jnp.where(is_first, feed_h, carry["recv_h"])
+            inp_e = jax.tree_util.tree_map(
+                lambda f, r: jnp.where(is_first, f, r), feed_e,
+                carry["recv_e"])
+            inp_mb = jnp.where(is_first, m, carry["recv_mb"])
+
+            # -- compute this device's chunk for the item it holds
+            my_c = jnp.clip((t - s), 0, v * M - 1) // M
+            h = chunk_apply(my_c, inp_h, inp_e, inp_mb)
+
+            # -- ring hop
+            return dict(
+                recv_h=jax.lax.ppermute(h, axis, ring),
+                recv_e=jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, axis, ring), inp_e),
+                recv_mb=jax.lax.ppermute(inp_mb, axis, ring),
+                buf=buf, outputs=outputs)
+
+        carry = jax.lax.fori_loop(0, T, tick, carry)
+        # the final item ((v-1, M-1)) arrives after the last tick's hop
+        outputs = _masked_row_update(carry["outputs"], jnp.asarray(M - 1),
+                                     carry["recv_h"], is_first)
+        outputs = jnp.where(is_first, outputs, 0.0)
+        return jax.lax.psum(outputs, axis)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), arranged)
+    return jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(param_specs, x_spec, extras_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(arranged, x_microbatches, extras)
+
+
+def gpipe_layer_stack(
+    apply_layer: Callable,
+    params_list,
+    x,
+    *,
+    num_microbatches: int,
+    layer_keys=None,
+    extras: Any = None,
+    extras_spec: Any = None,
+    x_spec: Optional[P] = None,
+    mesh: Optional[Mesh] = None,
+    schedule: str = "gpipe",
+    num_circuits: int = 1,
+    pre_interleaved: bool = False,
+):
+    """Model-facing wrapper: run a stack of identical layers through a
+    pipeline schedule (``schedule="gpipe"`` or ``"circular"`` — the
+    interleaved 1F1B placement with ``num_circuits`` virtual stages per
+    device; see :func:`circular_pipeline`).
+    Handles param stacking, per-layer dropout-key
+    stacking with microbatch + data-shard decorrelation (every (dp,fsdp)
+    shard holds different rows and must draw different masks), batch
+    microbatching, and the reshape back.
+
+    ``apply_layer(layer_params, h, extra, key) -> h``; ``params_list`` is
+    the per-layer param dicts in order — or an ALREADY-STACKED pytree
+    with (L, ...) leaves (the nn.module.StackedLayers layout, which is
+    pp-sharded from init and skips the in-graph stack + reshard);
+    ``x``: (B, ...) activations; ``extras``: optional (M, ...)
+    per-microbatch side inputs (microbatch them before calling). Used by
+    BERT and GPT's pipeline paths.
+    """
+    M = num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"pp_microbatches={M}")
+    stacked = (stack_layer_params(list(params_list))
+               if isinstance(params_list, (list, tuple)) else params_list)
+    if pre_interleaved and schedule != "circular":
+        raise ValueError(
+            "pre_interleaved params hold the circular schedule's layer "
+            "order; running them through schedule="
+            f"{schedule!r} would apply layers in the wrong order — "
+            "convert back with uninterleave_stack first")
+    has_keys = layer_keys is not None and layer_keys[0] is not None
+    if has_keys:
+        lkeys = jnp.stack(list(layer_keys))
+        if pre_interleaved and schedule == "circular":
+            # params are stored interleaved but keys are built fresh in
+            # canonical layer order every step — arrange them to match
+            # so the layer->key binding is layout-independent
+            mesh_ = mesh or mesh_lib.current_mesh()
+            lkeys = interleave_stack(lkeys, mesh_.shape[mesh_lib.PP],
+                                     num_circuits)
+        stacked = (stacked, lkeys)
+
+    def block(lp, h, extra, mb_idx):
+        if has_keys:
+            layer_params, lkey = lp
+            k = jax.random.fold_in(lkey, mb_idx)
+            k = jax.random.fold_in(
+                k, jax.lax.axis_index(("dp", "fsdp")))
+        else:
+            layer_params, k = lp, None
+        return apply_layer(layer_params, h, extra, k)
+
+    if x_spec is None:
+        x_spec = P(*((None, ("dp", "fsdp")) + (None,) * (x.ndim - 1)))
+    x_mb = x.reshape((M, b // M) + x.shape[1:])
+    if schedule == "circular":
+        out = circular_pipeline(block, stacked, x_mb,
+                                num_circuits=num_circuits, extras=extras,
+                                x_spec=x_spec, extras_spec=extras_spec,
+                                mesh=mesh, pre_interleaved=pre_interleaved)
+    elif schedule == "gpipe":
+        out = gpipe(block, stacked, x_mb, extras=extras, x_spec=x_spec,
+                    extras_spec=extras_spec, mesh=mesh)
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    return out.reshape(x.shape)
+
+
+def microbatch(batch, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...) over every leaf."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((num_microbatches, -1) + x.shape[1:]), batch)
+
+
+def unmicrobatch(batch):
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), batch)

@@ -1,0 +1,309 @@
+"""Ring attention: sequence/context parallelism over the "sp" mesh axis.
+
+Capability ABSENT in the reference (SURVEY.md §5.7 — fluid 1.5 predates
+long-context training; its story was LoD ragged tensors + DynamicRNN). The
+TPU build adds it as a first-class axis: q/k/v are sharded on the sequence
+dim over "sp"; each device computes attention between its local queries and
+a rotating k/v block that travels the ring via ``lax.ppermute`` (ICI
+neighbor exchange), merging partial results with the flash-attention
+online-softmax recurrence. Memory per device is O(S/n · S/n) per block and
+the k/v transfer overlaps compute under XLA's async collectives.
+
+Composes with GSPMD: call :func:`ring_attention` under jit with a mesh
+context; the shard_map boundary converts the GSPMD-sharded (B,H,S,D)
+arrays to per-device local blocks and back.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.ops.attention import NEG_INF
+
+
+def _block_update(carry, kv, *, scale, causal, q_offset, k_offset, seq_q_blk):
+    """One online-softmax step: fold (k,v[,bias]) block into (m, l, acc).
+
+    q_offset/k_offset are the GLOBAL start positions of the local q block
+    and the visiting k block (traced ints ok) — used for causal masking.
+    """
+    m_prev, l_prev, acc = carry
+    q, k, v, bias = kv
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        blk_k = k.shape[2]
+        row = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (seq_q_blk, blk_k), 0)
+        col = k_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (seq_q_blk, blk_k), 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next)
+    l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    acc_next = acc * alpha + pv
+    return m_next, l_next, acc_next
+
+
+def _ring_attention_local(q, k, v, bias, *, axis, scale, causal):
+    """Per-device body (inside shard_map). q,k,v local: (B,H,Sl,D)."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, h, sl, d = q.shape
+    q32 = q.astype(jnp.float32)
+
+    m = jnp.full((b, h, sl, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sl, 1), jnp.float32)
+    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        m, l, acc, k, v, bias = carry
+        # block currently held arrived from (idx - i) mod n
+        src = jax.lax.rem(idx - i + n, n)
+        m, l, acc = _block_update(
+            (m, l, acc),
+            (q32, k.astype(jnp.float32), v, bias),
+            scale=scale, causal=causal,
+            q_offset=idx * sl, k_offset=src * sl, seq_q_blk=sl)
+        k = jax.lax.ppermute(k, axis, perm)
+        v = jax.lax.ppermute(v, axis, perm)
+        if bias is not None:
+            bias = jax.lax.ppermute(bias, axis, perm)
+        return m, l, acc, k, v, bias
+
+    if bias is None:
+        # keep the carry pytree static: loop without a bias leaf
+        def step_nb(i, carry):
+            m, l, acc, k, v = carry
+            m, l, acc, k2, v2, _ = step(i, (m, l, acc, k, v, None))
+            return m, l, acc, k2, v2
+        m, l, acc, _, _ = jax.lax.fori_loop(0, n, step_nb, (m, l, acc, k, v))
+    else:
+        m, l, acc, _, _, _ = jax.lax.fori_loop(0, n, step,
+                                               (m, l, acc, k, v, bias))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (acc / denom).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-backed ring attention: flash kernel per visiting block
+# ---------------------------------------------------------------------------
+#
+# The composed path above materializes fp32 (B,H,Sl,Sl) score blocks per
+# ring step; at long context that caps MFU on HBM bandwidth. The flash path
+# keeps flash-level arithmetic intensity: each ring step runs the Pallas
+# forward kernel on (q_local, k_visiting) returning a NORMALIZED block
+# output plus its logsumexp, and blocks merge with the streaming
+# logaddexp recurrence:
+#     lse'   = logaddexp(lse, lse_blk)
+#     out'   = out * exp(lse - lse') + out_blk * exp(lse_blk - lse')
+# The whole per-device ring is ONE custom_vjp: the backward re-rotates
+# k/v around the ring with their grad accumulators, running the Pallas
+# FA2 backward kernels per block against the GLOBAL lse (so recomputed
+# probabilities match the merged forward exactly).
+
+
+def _ring_flash_case(idx, src, n):
+    """0 = diagonal block (causal masking inside), 1 = fully visible,
+    2 = fully masked (skip)."""
+    return jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+
+
+def _make_ring_flash(axis: str, scale: float, causal: bool,
+                     interpret: bool):
+    from paddle_tpu.ops import attention as A
+
+    def fwd_block(q, k, v, bias, case):
+        b, h, sl, d = q.shape
+
+        def diag(q, k, v, bias):
+            return A._flash_fwd(q, k, v, bias, scale=scale, causal=True,
+                                block_q=512, block_k=512,
+                                interpret=interpret, return_lse=True)
+
+        def full(q, k, v, bias):
+            return A._flash_fwd(q, k, v, bias, scale=scale, causal=False,
+                                block_q=512, block_k=512,
+                                interpret=interpret, return_lse=True)
+
+        def skip(q, k, v, bias):
+            return (jnp.zeros((b, h, sl, d), q.dtype),
+                    jnp.full((b, h, sl), NEG_INF, jnp.float32))
+
+        if not causal:
+            return full(q, k, v, bias)
+        return jax.lax.switch(case, [diag, full, skip], q, k, v, bias)
+
+    def bwd_block(q, k, v, bias, out, lse, g, case):
+        def diag(q, k, v, bias, out, lse, g):
+            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
+                                causal=True, block_q=512, block_k=512,
+                                interpret=interpret)
+
+        def full(q, k, v, bias, out, lse, g):
+            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
+                                causal=False, block_q=512, block_k=512,
+                                interpret=interpret)
+
+        def skip(q, k, v, bias, out, lse, g):
+            return (jnp.zeros_like(q), jnp.zeros_like(k),
+                    jnp.zeros_like(v))
+
+        if not causal:
+            return full(q, k, v, bias, out, lse, g)
+        return jax.lax.switch(case, [diag, full, skip],
+                              q, k, v, bias, out, lse, g)
+
+    @jax.custom_vjp
+    def ring_flash_local(q, k, v, bias):
+        out, _ = _ring_flash_fwd(q, k, v, bias)
+        return out
+
+    def _rot(x, perm):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.ppermute(a, axis, perm), x)
+
+    def _ring_flash_fwd(q, k, v, bias):
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        b, h, sl, d = q.shape
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        out = jnp.zeros((b, h, sl, d), jnp.float32)
+        lse = jnp.full((b, h, sl), NEG_INF, jnp.float32)
+
+        def step(i, carry):
+            out, lse, k, v, bias = carry
+            src = jax.lax.rem(idx - i + n, n)
+            o_blk, lse_blk = fwd_block(
+                q, k, v, bias, _ring_flash_case(idx, src, n))
+            lse_new = jnp.logaddexp(lse, lse_blk)
+            # guard fully-masked rows: both weights would be exp(NEG_INF -
+            # NEG_INF-ish) garbage; forcing weights to 0 keeps out at 0
+            alive = lse_new > NEG_INF / 2
+            w_old = jnp.where(alive, jnp.exp(lse - lse_new), 0.0)
+            w_blk = jnp.where(alive, jnp.exp(lse_blk - lse_new), 0.0)
+            out = out * w_old[..., None] \
+                + o_blk.astype(jnp.float32) * w_blk[..., None]
+            k, v, bias = _rot((k, v, bias), perm)
+            return out, lse_new, k, v, bias
+
+        out, lse, _, _, _ = jax.lax.fori_loop(
+            0, n, step, (out, lse, k, v, bias))
+        return out.astype(q.dtype), lse
+
+    def vjp_fwd(q, k, v, bias):
+        out, lse = _ring_flash_fwd(q, k, v, bias)
+        return out, (q, k, v, bias, out, lse)
+
+    def vjp_bwd(res, g):
+        q, k, v, bias, out, lse = res
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # fp32 accumulators: each ring step adds a partial; rounding to the
+        # input dtype per step would degrade grads as sp grows (the
+        # single-device kernel accumulates in fp32 scratch and rounds once)
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dk = jnp.zeros(k.shape, jnp.float32)
+        dv = jnp.zeros(v.shape, jnp.float32)
+
+        def step(i, carry):
+            dq, k, v, bias, dk, dv = carry
+            src = jax.lax.rem(idx - i + n, n)
+            dq_blk, dk_blk, dv_blk = bwd_block(
+                q, k, v, bias, out, lse, g,
+                _ring_flash_case(idx, src, n))
+            dq = dq + dq_blk.astype(jnp.float32)
+            dk = dk + dk_blk.astype(jnp.float32)
+            dv = dv + dv_blk.astype(jnp.float32)
+            # grads rotate WITH their block: after n hops they are home
+            k, v, bias, dk, dv = _rot((k, v, bias, dk, dv), perm)
+            return dq, k, v, bias, dk, dv
+
+        dq, _, _, _, dk, dv = jax.lax.fori_loop(
+            0, n, step, (dq, k, v, bias, dk, dv))
+        # key-padding bias is a constant mask (flash_attention convention;
+        # ring_attention stop-gradients bias for BOTH impls)
+        dbias = jnp.zeros_like(bias) if bias is not None else None
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), \
+            dbias
+
+    ring_flash_local.defvjp(vjp_fwd, vjp_bwd)
+    return ring_flash_local
+
+
+def ring_attention(q, k, v, *, bias=None, causal=False,
+                   scale: Optional[float] = None,
+                   axis: str = mesh_lib.SP, mesh: Optional[Mesh] = None,
+                   impl: str = "auto"):
+    """Sequence-parallel attention. q,k,v: (B,H,S,D) with S sharded over
+    ``axis``; ``bias`` optional key-padding bias (B,1,1,S) sharded on S.
+
+    ``impl``: "xla" (composed online-softmax blocks), "flash" (Pallas
+    kernel per ring block — flash-level arithmetic intensity under sp>1),
+    "flash_interpret" (tests on CPU), "auto" (flash on TPU, xla elsewhere).
+    Must run under a mesh (pjit/jit with mesh context). Returns (B,H,S,D)
+    with the same sharding as q.
+
+    ``bias`` is a CONSTANT mask: it is stop-gradiented on every impl (the
+    flash kernels do not produce bias cotangents; stopping it on the xla
+    path too keeps gradients backend-independent). Trainable attention
+    biases are incompatible with sequence-parallel ring attention here.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention requires a mesh "
+                         "(use mesh_context or pass mesh=)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None:
+        bias = jax.lax.stop_gradient(bias)
+    if impl == "auto":
+        from paddle_tpu.ops.attention import _on_tpu, pltpu
+        impl = "flash" if (pltpu is not None and _on_tpu()) else "xla"
+
+    qkv_spec = P(mesh_lib.BATCH_AXES, mesh_lib.TP, axis, None)
+    bias_spec = P(mesh_lib.BATCH_AXES, None, None, axis)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec)
+    args = (q, k, v)
+
+    if impl in ("flash", "flash_interpret"):
+        local = _make_ring_flash(axis, scale, causal,
+                                 interpret=impl == "flash_interpret")
+        if bias is not None:
+            in_specs = in_specs + (bias_spec,)
+            args = args + (bias,)
+
+            def body(q, k, v, bias):
+                return local(q, k, v, bias)
+        else:
+            def body(q, k, v):
+                return local(q, k, v, None)
+    elif bias is not None:
+        in_specs = in_specs + (bias_spec,)
+        args = args + (bias,)
+
+        def body(q, k, v, bias):
+            return _ring_attention_local(q, k, v, bias, axis=axis,
+                                         scale=scale, causal=causal)
+    else:
+        def body(q, k, v):
+            return _ring_attention_local(q, k, v, None, axis=axis,
+                                         scale=scale, causal=causal)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
+        check_vma=False,
+    )(*args)

@@ -1,0 +1,365 @@
+"""Model compression (slim): pruning + distillation.
+
+Reference mapping: ``python/paddle/fluid/contrib/slim/`` —
+- ``prune`` (SensitivePruneStrategy / magnitude pruning of conv/fc
+  weights): here masks are a PYTREE the train step re-applies after each
+  optimizer update, so pruned training is one functional transform (no
+  graph surgery); sensitivity analysis sweeps per-layer sparsities.
+- ``distillation`` (soft-label loss, FSP matrix loss): pure loss-term
+  helpers combined into the student's loss function.
+- quantization lives in ``ops/quant.py`` (fake-quant + STE).
+
+TPU notes: masks are multiplicative 0/1 arrays — XLA fuses the multiply
+into the producer; on MXU-sized blocks magnitude pruning keeps dense
+matmul shapes (structured sparsity in hardware is out of scope for v5e).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+def _prunable(path: Tuple[str, ...], leaf) -> bool:
+    """Weight matrices/filters only — never biases, norms, or embeddings'
+    1-D state (slim prunes conv/fc weights)."""
+    name = path[-1] if path else ""
+    return getattr(leaf, "ndim", 0) >= 2 and name in ("weight", "w")
+
+
+def magnitude_prune_masks(params, sparsity: float, *,
+                          predicate: Optional[Callable] = None):
+    """Per-layer magnitude masks: zero the smallest-|w| ``sparsity``
+    fraction of each prunable leaf (SensitivePruneStrategy's ratio
+    pruning). Returns a 0/1 mask pytree matching ``params``."""
+    if not 0.0 <= sparsity < 1.0:
+        raise ValueError(f"sparsity must be in [0, 1); got {sparsity}")
+    predicate = predicate or _prunable
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if predicate(path, tree) and sparsity > 0.0:
+            k = int(round(tree.size * sparsity))
+            if k == 0:
+                return jnp.ones_like(tree)
+            flat = jnp.abs(tree).ravel()
+            thresh = jnp.sort(flat)[k - 1]
+            return (jnp.abs(tree) > thresh).astype(tree.dtype)
+        return jnp.ones_like(tree) if hasattr(tree, "shape") else tree
+
+    return walk(params, ())
+
+
+def apply_masks(params, masks):
+    return jax.tree_util.tree_map(lambda p, m: p * m, params, masks)
+
+
+def sparsity_of(masks) -> float:
+    """Achieved global sparsity over the masked leaves."""
+    zeros = total = 0
+    for m in jax.tree_util.tree_leaves(masks):
+        zeros += int(m.size) - int(jnp.count_nonzero(m))
+        total += int(m.size)
+    return zeros / max(total, 1)
+
+
+def pruned_train_step(step: Callable, masks) -> Callable:
+    """Wrap a train step so the masks are re-applied after every update
+    (pruned weights stay zero through optimizer momentum/adam states —
+    the retrain phase of slim's prune strategy)."""
+
+    def wrapped(state, **batch):
+        state, metrics = step(state, **batch)
+        state = dict(state,
+                     params=apply_masks(state["params"], masks))
+        return state, metrics
+
+    return wrapped
+
+
+def sensitivity_analysis(loss_fn: Callable, params, *,
+                         sparsities: Sequence[float] = (0.3, 0.5, 0.7),
+                         predicate: Optional[Callable] = None
+                         ) -> Dict[Tuple[str, ...], Dict[float, float]]:
+    """Per-layer sensitivity sweep (slim sensitive.py): prune ONE layer at
+    a time to each ratio and record the loss. Returns
+    {layer_path: {sparsity: loss}} — pick per-layer ratios by loss budget."""
+    predicate = predicate or _prunable
+    base = float(loss_fn(params))
+
+    paths = [p for p, leaf in _iter_leaves(params, ())
+             if predicate(p, leaf)]
+    out: Dict[Tuple[str, ...], Dict[float, float]] = {}
+    for path in paths:
+        out[path] = {0.0: base}
+        for s in sparsities:
+            only_this = (lambda p, leaf, target=path:
+                         p == target)
+            masks = magnitude_prune_masks(params, s, predicate=only_this)
+            out[path][s] = float(loss_fn(apply_masks(params, masks)))
+    return out
+
+
+def _iter_leaves(tree, path):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _iter_leaves(v, path + (k,))
+    else:
+        yield path, tree
+
+
+# ---------------------------------------------------------------------------
+# distillation
+# ---------------------------------------------------------------------------
+
+def soft_label_loss(student_logits, teacher_logits, *,
+                    temperature: float = 1.0):
+    """KD soft-target cross-entropy (slim distillation_strategy soft-label
+    loss): KL(teacher_T || student_T) * T^2, mean over batch."""
+    t = temperature
+    sp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, -1)
+    tp = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, -1)
+    tlogp = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t, -1)
+    kl = (tp * (tlogp - sp)).sum(-1)
+    return kl.mean() * (t * t)
+
+
+def fsp_matrix(a, b):
+    """Flow-of-solution-procedure matrix (slim FSP distillation): feature
+    maps a (B, H, W, Ca), b (B, H, W, Cb) -> (B, Ca, Cb) Gram flow."""
+    ba, h, w, ca = a.shape
+    bb, h2, w2, cb = b.shape
+    if (ba, h, w) != (bb, h2, w2):
+        raise ValueError(f"FSP needs matching spatial dims; {a.shape} vs "
+                         f"{b.shape}")
+    af = a.reshape(ba, h * w, ca)
+    bf = b.reshape(bb, h * w, cb)
+    return jnp.einsum("bnc,bnd->bcd", af, bf) / (h * w)
+
+
+def fsp_loss(student_pairs, teacher_pairs):
+    """Mean L2 between student/teacher FSP matrices over given feature
+    pairs: [((a_s, b_s), (a_t, b_t)), ...]."""
+    losses = []
+    for (a_s, b_s), (a_t, b_t) in zip(student_pairs, teacher_pairs):
+        fs = fsp_matrix(a_s, b_s)
+        ft = fsp_matrix(a_t, b_t)
+        losses.append(((fs - ft) ** 2).mean())
+    return jnp.stack(losses).mean()
+
+
+# ---------------------------------------------------------------------------
+# post-training quantization (weight-only int8)
+# ---------------------------------------------------------------------------
+#
+# slim's quant story has two halves: quant-aware training (fake-quant +
+# STE, ops/quant.py) and post-training quantization of a trained model.
+# This is the PTQ half for serving: weights stored int8 + per-channel
+# scales (4x smaller artifacts, HBM-bandwidth relief), dequantized to the
+# compute dtype at load/use — the WeightQuantization path of
+# contrib/slim's quantization_pass.
+
+def quantize_weights_int8(params, *, predicate: Optional[Callable] = None,
+                          per_channel: bool = True):
+    """Symmetric int8 weight quantization. Returns a pytree where each
+    quantized leaf becomes {"q": int8, "scale": f32, "axis": int}; other
+    leaves pass through. ``per_channel``: scale per output channel (last
+    dim) — the accuracy-preserving default."""
+    predicate = predicate or _prunable
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if predicate(path, tree):
+            w = jnp.asarray(tree)
+            if per_channel:
+                amax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)),
+                               keepdims=True)
+            else:
+                amax = jnp.max(jnp.abs(w))
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+            return {"q": q, "scale": scale.astype(jnp.float32),
+                    "axis": -1 if per_channel else None}
+        return tree
+
+    return walk(params, ())
+
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, dict) and set(node) == {"q", "scale", "axis"}
+
+
+def dequantize_weights(qparams, dtype=jnp.float32):
+    """Inverse of :func:`quantize_weights_int8`: rebuild a dense param
+    pytree in ``dtype`` (serve-time load path)."""
+
+    def walk(node):
+        if _is_qleaf(node):
+            return (node["q"].astype(jnp.float32)
+                    * node["scale"]).astype(dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def quantization_error(params, qparams) -> Dict[Tuple[str, ...], float]:
+    """Per-quantized-leaf relative L2 error — the accuracy-budget
+    diagnostic before shipping a quantized artifact."""
+    deq = dequantize_weights(qparams)
+    out = {}
+
+    def walk(a, b, q, path):
+        if isinstance(a, dict):
+            for k in a:
+                walk(a[k], b[k], q[k], path + (k,))
+        elif _is_qleaf(q):
+            num = float(jnp.linalg.norm((a - b).ravel()))
+            den = float(jnp.linalg.norm(jnp.asarray(a).ravel())) or 1.0
+            out[path] = num / den
+
+    walk(params, deq, qparams, ())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NAS (light): simulated-annealing architecture search
+# ---------------------------------------------------------------------------
+
+def sa_search(space: Dict[str, Sequence], eval_fn: Callable[[dict], float],
+              *, iters: int = 50, init_temp: float = 1.0,
+              cooling: float = 0.95, seed: int = 0,
+              init: Optional[dict] = None):
+    """Simulated-annealing search over a discrete config space (slim
+    light_nas ``sa_controller`` analog: mutate one knob per step, accept
+    worse candidates with exp(-delta/T), anneal T).
+
+    ``space``: {knob: [choices...]}; ``eval_fn(config) -> float`` is the
+    reward to MAXIMIZE (e.g. -latency-penalized eval loss). Returns
+    (best_config, best_reward, history).
+    """
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+    keys = sorted(space)
+    cur = dict(init) if init is not None else \
+        {k: space[k][int(rng.integers(len(space[k])))] for k in keys}
+    for k in keys:
+        if cur[k] not in list(space[k]):
+            raise ValueError(f"init[{k!r}]={cur[k]!r} not in space")
+    cur_r = float(eval_fn(cur))
+    best, best_r = dict(cur), cur_r
+    temp = init_temp
+    history = [(dict(cur), cur_r)]
+    # only knobs with >1 choice can move; single-choice knobs would waste
+    # a full eval per no-op mutation (eval_fn is a training run in NAS)
+    mutable = [k for k in keys if len(space[k]) > 1]
+    if not mutable:
+        return best, best_r, history
+    for _ in range(iters):
+        cand = dict(cur)
+        k = mutable[int(rng.integers(len(mutable)))]
+        choices = [c for c in space[k] if c != cand[k]]
+        cand[k] = choices[int(rng.integers(len(choices)))]
+        r = float(eval_fn(cand))
+        if r >= cur_r or rng.random() < _np.exp((r - cur_r)
+                                                / max(temp, 1e-8)):
+            cur, cur_r = cand, r
+        if cur_r > best_r:
+            best, best_r = dict(cur), cur_r
+        history.append((dict(cand), r))
+        temp *= cooling
+    return best, best_r, history
+
+
+def distill_loss_fn(student_loss_fn: Callable, teacher_fn: Callable, *,
+                    alpha: float = 0.5, temperature: float = 2.0
+                    ) -> Callable:
+    """Combine hard-label student loss with the KD term:
+        loss = (1-alpha) * student_loss + alpha * KD(student, teacher)
+
+    ``student_loss_fn(params, **batch) -> (loss, {"logits": ...})`` must
+    expose logits in its aux; ``teacher_fn(**batch) -> logits`` runs the
+    (frozen) teacher — close over its params and stop_gradient them.
+    """
+
+    def loss(params, **batch):
+        hard, aux = student_loss_fn(params, **batch)
+        teacher_logits = jax.lax.stop_gradient(teacher_fn(**batch))
+        kd = soft_label_loss(aux["logits"], teacher_logits,
+                             temperature=temperature)
+        total = (1 - alpha) * hard + alpha * kd
+        return total, dict(aux, hard_loss=hard, kd_loss=kd)
+
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Quantization-aware training (contrib/slim/quantization
+# QuantizationTransformPass parity). The reference rewrites the program
+# graph inserting fake_quantize/dequantize ops before quantizable ops; here
+# the analogous transform wraps the loss function: weights are fake-quantized
+# (STE gradients, ops/quant.py) on the way into the forward pass, so
+# training observes int8 rounding while optimizer state stays fp32.
+# ---------------------------------------------------------------------------
+
+
+def _fake_quant_params(params, *, bit_length: int,
+                       predicate: Optional[Callable],
+                       channel_wise: bool):
+    """Shared walk: fake-quantize quantizable leaves (STE grads)."""
+    from paddle_tpu.ops import quant as Q
+
+    pred = predicate or _prunable
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if not pred(path, tree):
+            return tree
+        if channel_wise and tree.ndim >= 2:
+            return Q.fake_channel_wise_quantize_abs_max(
+                tree, bit_length=bit_length)[0]
+        return Q.fake_quantize_abs_max(tree, bit_length=bit_length)[0]
+
+    return walk(params)
+
+
+def qat_transform(loss_fn: Callable, *, bit_length: int = 8,
+                  predicate: Optional[Callable] = None,
+                  channel_wise: bool = False) -> Callable:
+    """Wrap ``loss_fn(params, **batch)`` so quantizable weights pass
+    through fake-quant (abs-max, STE) first. ``predicate(path, leaf)``
+    selects leaves (default: the same >=2-D weight rule as pruning)."""
+
+    @functools.wraps(loss_fn)
+    def wrapped(params, *args, **kwargs):
+        return loss_fn(
+            _fake_quant_params(params, bit_length=bit_length,
+                               predicate=predicate,
+                               channel_wise=channel_wise),
+            *args, **kwargs)
+
+    return wrapped
+
+
+def qat_convert(params, *, bit_length: int = 8,
+                predicate: Optional[Callable] = None,
+                channel_wise: bool = False):
+    """Freeze QAT training into deployment weights
+    (QuantizationFreezePass parity): snap quantizable leaves to the SAME
+    fake-quant grid training observed — pass the ``channel_wise`` used in
+    :func:`qat_transform`."""
+    return _fake_quant_params(params, bit_length=bit_length,
+                              predicate=predicate,
+                              channel_wise=channel_wise)

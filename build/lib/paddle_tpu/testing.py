@@ -105,19 +105,3 @@ def assert_trees_close(a, b, rtol=1e-5, atol=1e-6):
     for x, y in zip(la, lb):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=rtol, atol=atol)
-
-
-def subprocess_env(repo_on_path: bool = True):
-    """Environment for CPU-only worker subprocesses spawned by tests and
-    launchers: the repo on PYTHONPATH and the TPU-plugin sitecustomize
-    trigger stripped (its register() can block interpreter start when
-    the device tunnel is flaky — a CPU worker never needs it)."""
-    import os
-
-    env = dict(os.environ)
-    if repo_on_path:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        extra = env.get("PYTHONPATH", "")
-        env["PYTHONPATH"] = repo + (os.pathsep + extra if extra else "")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    return env

@@ -1,0 +1,156 @@
+"""High-level training driver: epochs, checkpointing, resume, logging.
+
+Reference mapping: the Trainer/DeviceWorker runtime —
+``Executor::RunFromDataset`` (executor.cc:168), ``MultiTrainer`` thread-per
+-worker loops (multi_trainer.cc:69), ``PullDenseWorker``, fetch-var printing
+(``device_worker.h`` PrintFetchVars) and the checkpoint conventions of
+``io.py save_persistables``. TPU-native: ONE jitted step consumed in a host
+loop; the worker threads collapse into the data loader's prefetch thread +
+XLA's async dispatch. Failure recovery = auto-resume from the newest
+checkpoint (SURVEY.md §5.3: the reference's story is also
+restart-from-checkpoint; here it is built in).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+
+from paddle_tpu import io as io_lib
+
+
+class Trainer:
+    """Epoch/step driver over a jitted train step.
+
+    train_step(state, **batch) -> (state, metrics) — built by
+    paddle_tpu.train.build_train_step (or amp.scaled_train_step) and
+    optionally sharded by parallel.api.shard_train_step.
+    """
+
+    def __init__(self, train_step: Callable, state: Any, *,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1000,
+                 keep_checkpoints: int = 3,
+                 log_every: int = 100,
+                 log_fn: Callable[[str], None] = print,
+                 hooks: Iterable[Callable] = ()):
+        self.train_step = train_step
+        self.state = state
+        self.log_every = log_every
+        self.log_fn = log_fn
+        self.hooks = list(hooks)  # hook(trainer, step, metrics)
+        self.checkpoint_every = checkpoint_every
+        self.manager = None
+        if checkpoint_dir is not None:
+            self.manager = io_lib.CheckpointManager(
+                checkpoint_dir, max_to_keep=keep_checkpoints,
+                save_interval_steps=checkpoint_every)
+
+    # -- resume ------------------------------------------------------------
+    def restore(self) -> int:
+        """Resume from the newest checkpoint if one exists. Returns the
+        restored step (0 if none)."""
+        if self.manager is None or self.manager.latest_step() is None:
+            return 0
+        restored = self.manager.restore(target=jax.device_get(self.state))
+        self.state = restored
+        step = int(restored["step"])
+        self.log_fn(f"[trainer] resumed from step {step}")
+        return step
+
+    @property
+    def step_count(self) -> int:
+        return int(self.state["step"])
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, data_iter: Iterable[Dict[str, Any]], *,
+            epochs: int = 1,
+            steps_per_epoch: Optional[int] = None,
+            make_iter: Optional[Callable] = None) -> Dict[str, float]:
+        """Train over batches. ``data_iter`` is an iterable of feed dicts
+        (re-created per epoch via ``make_iter`` when given — pass the
+        dataset's ``.batches`` factory for multi-epoch runs)."""
+        if epochs > 1 and make_iter is None and not hasattr(
+                data_iter, "__len__"):
+            raise ValueError(
+                "epochs > 1 with a one-shot iterator: pass make_iter= so "
+                "each epoch gets a fresh pass over the data")
+        last_metrics: Dict[str, float] = {}
+        metrics: Dict[str, Any] = {}
+        # host-mirrored global step: one device sync here, none in the loop
+        gstep = self.step_count
+        for epoch in range(epochs):
+            it = make_iter() if make_iter is not None else data_iter
+            t0 = time.perf_counter()
+            n = 0
+            for batch in it:
+                self.state, metrics = self.train_step(self.state, **batch)
+                n += 1
+                gstep += 1
+                if self.log_every and n % self.log_every == 0:
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    rate = n / (time.perf_counter() - t0)
+                    self.log_fn(
+                        f"[trainer] epoch {epoch} step {gstep} "
+                        f"{_fmt(last_metrics)} ({rate:.1f} it/s)")
+                # gate on the GLOBAL step so epochs shorter than
+                # checkpoint_every still checkpoint across epochs
+                if self.manager is not None \
+                        and gstep % self.checkpoint_every == 0:
+                    # label with the TRUE state step — gstep can drift ahead
+                    # when a step declines to increment (AMP overflow skips);
+                    # the sync is per-checkpoint, not per-step
+                    host_state = jax.device_get(self.state)
+                    gstep = int(host_state["step"])
+                    self.manager.save(gstep, host_state)
+                for hook in self.hooks:
+                    hook(self, n, metrics)
+                if steps_per_epoch and n >= steps_per_epoch:
+                    break
+            if n == 0:
+                raise ValueError(
+                    f"epoch {epoch} yielded no batches (exhausted "
+                    "iterator? pass make_iter= for multi-epoch runs)")
+            last_metrics = {k: float(v) for k, v in metrics.items()}
+            self.log_fn(f"[trainer] epoch {epoch} done: {_fmt(last_metrics)}")
+        if self.manager is not None:
+            last = self.step_count
+            if self.manager.latest_step() != last:
+                self.manager.save(last, jax.device_get(self.state),
+                                  wait=True, force=True)
+            else:
+                self.manager.wait()
+        return last_metrics
+
+    def evaluate(self, eval_step: Callable,
+                 data_iter: Iterable[Dict[str, Any]],
+                 metrics: Optional[Dict[str, Any]] = None):
+        """Run eval_step(params, **batch) over batches; streams into
+        paddle_tpu.metrics objects when given ({name: (metric, extractor)})."""
+        outs = []
+        for batch in data_iter:
+            out = eval_step(self.state["params"], **batch)
+            if metrics:
+                for name, (metric, extract) in metrics.items():
+                    metric.update(*extract(out, batch))
+            else:
+                outs.append(out)
+        if metrics:
+            return {name: m.eval() for name, (m, _) in metrics.items()}
+        return outs
+
+    def predict(self, predict_step: Callable,
+                data_iter: Iterable[Dict[str, Any]]):
+        """Forward-only pass collecting host numpy outputs per batch
+        (hapi Model.predict / infer_from_dataset convenience)."""
+        outs = []
+        for batch in data_iter:
+            out = predict_step(self.state["params"], **batch)
+            outs.append(jax.device_get(out))   # pytree -> host numpy
+        return outs
+
+
+def _fmt(metrics: Dict[str, float]) -> str:
+    return " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))

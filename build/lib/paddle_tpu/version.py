@@ -1,0 +1,3 @@
+"""Version info for paddle_tpu."""
+
+__version__ = "0.1.0"

@@ -36,10 +36,8 @@ def _free_port():
 
 
 def _env():
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    extra = env.get("PYTHONPATH", "")
-    env["PYTHONPATH"] = repo + (os.pathsep + extra if extra else "")
+    from paddle_tpu.testing import subprocess_env
+    env = subprocess_env()
     # a virtual-device-count flag from the parent suite would give every
     # worker 8 local devices and break the 2-process topology
     if "XLA_FLAGS" in env:

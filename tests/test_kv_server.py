@@ -94,9 +94,8 @@ class TestInProcessServer:
 
 
 def _spawn_pserver(dim):
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from paddle_tpu.testing import subprocess_env
+    env = subprocess_env()
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.parallel.kv_server",
          "--dim", str(dim), "--port", "0", "--optimizer", "adagrad"],

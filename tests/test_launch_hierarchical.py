@@ -75,9 +75,8 @@ _WORKER_SCRIPT = textwrap.dedent("""
 def _run_launch(tmp_path, extra, script_args):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER_SCRIPT)
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from paddle_tpu.testing import subprocess_env
+    env = subprocess_env()
     return subprocess.run(
         [sys.executable, "-m", "paddle_tpu.launch", *extra, str(script),
          str(tmp_path), *script_args],
