@@ -294,7 +294,9 @@ class FLCommunicator:
         w = w / total
 
         def merge(p):
-            return jnp.tensordot(w, p, axes=1)
+            # cast back per-leaf: tensordot with f32 weights must not
+            # silently promote bf16/int leaves round over round
+            return jnp.tensordot(w, p, axes=1).astype(p.dtype)
 
         self.rounds += 1
         return jax.tree_util.tree_map(merge, stacked_params)

@@ -63,13 +63,13 @@ class TestWheel:
             "assert len(s) == 5\n"
             "print('WHEEL OK', paddle_tpu.__version__)\n"
         )
-        env = dict(os.environ)
-        # ONLY the installed copy on the path: no repo shadowing, and no
-        # TPU-plugin sitecustomize (its register() blocks interpreter
-        # start when the tunnel is flaky; this check is CPU-only anyway)
+        from paddle_tpu.testing import subprocess_env
+
+        # ONLY the installed copy on the path (no repo shadowing); the
+        # helper strips the TPU-plugin sitecustomize trigger
+        env = subprocess_env(repo_on_path=False)
         env["PYTHONPATH"] = target
         env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
         r = subprocess.run([sys.executable, "-c", check], env=env,
                            capture_output=True, text=True, timeout=600,
                            cwd=str(tmp_path))

@@ -1,0 +1,40 @@
+#!/bin/sh
+# One-shot TPU measurement session (run the moment the axon tunnel is up).
+# Produces, in order, with per-step logs under tools/tpu_logs/:
+#   BENCH_r04.json            BERT-base (the driver's headline metric)
+#   BENCH_RESNET.json         ResNet-50 (target vs_baseline >= 1.0)
+#   BENCH_TRANSFORMER.json    Transformer-big packed varlen (config 4)
+#   BENCH_DEEPFM.json         DeepFM host-KV CTR (config 5)
+#   NATIVE_E2E.txt            the PJRT C++ runner end-to-end parity proof
+# Safe to re-run; each step is independent and fail-soft.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p tools/tpu_logs
+
+run() {
+  name="$1"; shift
+  echo "== $name =="
+  "$@" > "tools/tpu_logs/$name.out" 2> "tools/tpu_logs/$name.err"
+  echo "rc=$?"
+  tail -c 2000 "tools/tpu_logs/$name.out"
+}
+
+run bert       timeout 1800 python bench.py
+cp tools/tpu_logs/bert.out BENCH_r04.json 2>/dev/null || true
+
+run resnet     timeout 1800 python bench.py --model resnet50
+cp tools/tpu_logs/resnet.out BENCH_RESNET.json 2>/dev/null || true
+
+run transformer timeout 1800 python bench.py --model transformer
+cp tools/tpu_logs/transformer.out BENCH_TRANSFORMER.json 2>/dev/null || true
+
+run deepfm     timeout 1800 python bench.py --model deepfm
+cp tools/tpu_logs/deepfm.out BENCH_DEEPFM.json 2>/dev/null || true
+
+# the hardware-gated native-runner parity test (must NOT skip on TPU)
+run native_e2e timeout 900 python -m pytest \
+    tests/test_native_inference.py::TestNativeExecution -q -rs
+cp tools/tpu_logs/native_e2e.out NATIVE_E2E.txt 2>/dev/null || true
+
+echo "session done; artifacts: BENCH_r04.json BENCH_RESNET.json \
+BENCH_TRANSFORMER.json BENCH_DEEPFM.json NATIVE_E2E.txt"
