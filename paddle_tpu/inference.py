@@ -129,6 +129,75 @@ def save_inference_model(path: str, fn, params: Any,
         json.dump(meta, f, indent=2)
 
 
+def _is_bn(node) -> bool:
+    return (isinstance(node, dict)
+            and {"scale", "bias", "mean", "variance"} <= set(node))
+
+
+def _fold_pair(conv, bn, eps):
+    """Fold an eval-mode BatchNorm into the preceding conv's params.
+
+    Returns (conv', bn') computing the identical function: the per-
+    channel scale s = gamma/sqrt(var+eps) moves INTO the conv weight
+    (last axis = out channels — also what int8 export should quantize),
+    and bn' degenerates to a pure bias add (scale 1, mean 0,
+    variance 1-eps so sqrt(var+eps) == 1 exactly)."""
+    import jax.numpy as jnp
+
+    s = bn["scale"] / jnp.sqrt(bn["variance"] + eps)
+    conv = dict(conv)
+    conv["weight"] = conv["weight"] * s
+    if "bias" in conv:
+        new_bias = bn["bias"] + s * (conv["bias"] - bn["mean"])
+        conv["bias"] = jnp.zeros_like(conv["bias"])
+    else:
+        new_bias = bn["bias"] - s * bn["mean"]
+    bn = dict(bn)
+    bn["scale"] = jnp.ones_like(bn["scale"])
+    bn["bias"] = new_bias
+    bn["mean"] = jnp.zeros_like(bn["mean"])
+    bn["variance"] = jnp.ones_like(bn["variance"]) * (1.0 - eps)
+    return conv, bn
+
+
+def fold_batch_norms(params, eps: float = 1e-5):
+    """Inference-time conv+BN folding (the reference's
+    ``conv_bn_fuse_pass``, framework/ir/conv_bn_fuse_pass.cc — there an
+    IR pass over the frozen graph; here a param-tree transform).
+
+    Detects the two layouts the model zoo uses: a ``{"conv": .., "bn":
+    ..}`` sibling pair (ConvBNLayer — ResNet/MobileNet/SE-ResNeXt/
+    detectors) and parallel ``{"convs": {i: ..}, "bns": {i: ..}}``
+    LayerLists (VGG). EVAL graphs only — training mode recomputes batch
+    statistics, which folding cannot represent. The transformed tree
+    evaluates identically (BN degenerates to the bias add), so it drops
+    into the same model object; pair with
+    ``save_inference_model(weight_quantize="int8")`` so quantization
+    sees the folded weights."""
+    if not isinstance(params, dict):
+        return params
+    out = {k: fold_batch_norms(v, eps) for k, v in params.items()}
+    if ("conv" in out and "bn" in out and _is_bn(out["bn"])
+            and isinstance(out["conv"], dict) and "weight" in out["conv"]):
+        out["conv"], out["bn"] = _fold_pair(out["conv"], out["bn"], eps)
+    if (isinstance(out.get("convs"), dict)
+            and isinstance(out.get("bns"), dict)
+            # fold ONLY index-aligned lists (bns[i] follows convs[i], the
+            # VGG layout). A key mismatch means an offset mapping — e.g.
+            # DCGAN's discriminator has bns[i] after convs[i+1] — where
+            # positional folding would silently corrupt the function.
+            and set(out["convs"]) == set(out["bns"])):
+        for i in out["bns"]:
+            if (_is_bn(out["bns"][i])
+                    and isinstance(out["convs"][i], dict)
+                    and "weight" in out["convs"][i]):
+                out["convs"] = dict(out["convs"])
+                out["bns"] = dict(out["bns"])
+                out["convs"][i], out["bns"][i] = _fold_pair(
+                    out["convs"][i], out["bns"][i], eps)
+    return out
+
+
 def load_inference_model(path: str) -> "Predictor":
     return Predictor(path)
 

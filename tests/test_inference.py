@@ -153,3 +153,78 @@ class TestInt8Serving:
             inference.save_inference_model(
                 str(tmp_path / "bad"), lambda p, a: model(p, a),
                 params, [x], weight_quantize="int4")
+
+
+class TestConvBNFolding:
+    """conv_bn_fuse_pass parity (framework/ir/conv_bn_fuse_pass.cc):
+    folding BN into conv weights preserves the eval function exactly."""
+
+    def test_resnet18_fold_exact(self):
+        from paddle_tpu.models.resnet import ResNet
+
+        model = ResNet(18, num_classes=10, width=16)
+        params = model.init(jax.random.PRNGKey(0))
+
+        # make running stats non-trivial so folding actually moves values
+        def perturb(tree):
+            if isinstance(tree, dict):
+                out = {k: perturb(v) for k, v in tree.items()}
+                if {"scale", "bias", "mean", "variance"} <= set(out):
+                    out["mean"] = out["mean"] + 0.3
+                    out["variance"] = out["variance"] * 1.7
+                    out["scale"] = out["scale"] * 0.9
+                return out
+            return tree
+
+        params = perturb(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        ref = model(params, x, training=False)
+        folded = inference.fold_batch_norms(params)
+        got = model(folded, x, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+        # the fold really moved the scale into the weights
+        flat = jax.tree_util.tree_leaves_with_path(folded)
+        scales = [l for p, l in flat if "scale" in str(p[-1])
+                  and l.ndim == 1]
+        assert any(np.allclose(np.asarray(s), 1.0) for s in scales)
+
+    def test_vgg_parallel_lists_fold(self):
+        from paddle_tpu.models.vgg import VGG
+
+        model = VGG(11, num_classes=4, batch_norm=True)
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3))
+        ref = model(params, x, training=False)
+        got = model(inference.fold_batch_norms(params), x, training=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_fold_then_int8_export(self, tmp_path):
+        from paddle_tpu.models.mobilenet import MobileNetV1
+
+        model = MobileNetV1(num_classes=5, scale=0.25)
+        params = model.init(jax.random.PRNGKey(0))
+        x = np.random.default_rng(0).normal(
+            size=(1, 64, 64, 3)).astype(np.float32)
+        folded = inference.fold_batch_norms(params)
+        ref = np.asarray(model(folded, jnp.asarray(x), training=False))
+        d = str(tmp_path / "mn_int8")
+        inference.save_inference_model(
+            d, lambda p, a: model(p, a, training=False), folded, [x],
+            weight_quantize="int8")
+        out = np.asarray(inference.Predictor(d).run(x))
+        np.testing.assert_allclose(out, ref, atol=0.35, rtol=0.3)
+
+    def test_offset_mapped_lists_left_alone(self):
+        """DCGAN's discriminator has convs/bns with OFFSET index mapping
+        (bns[i] follows convs[i+1]); the structural fold must skip it
+        rather than corrupt the function."""
+        from paddle_tpu.models.gan import DCGANDiscriminator
+
+        model = DCGANDiscriminator()
+        params = model.init(jax.random.PRNGKey(0))
+        folded = inference.fold_batch_norms(params)
+        for a, b in zip(jax.tree_util.tree_leaves(folded),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -15,15 +15,21 @@ import time
 import jax
 import jax.numpy as jnp
 
+# runnable as `python tools/<name>.py` from anywhere: repo root on path
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
-def one(batch_size, attn_impl, remat=False, seq=512, steps=12):
+
+def one(batch_size, attn_impl, remat=False, stacked=False, seq=512,
+        steps=12):
+    from bench import count_params, device_peak_flops
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import dtypes
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
     from paddle_tpu.train import build_train_step, make_train_state
 
     cfg = BertConfig.base(dropout=0.0, attn_dropout=0.0,
-                          attn_impl=attn_impl)
+                          attn_impl=attn_impl, stacked_layers=stacked)
     model = BertForPretraining(cfg)
     optimizer = opt.AdamW(learning_rate=1e-4)
     state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
@@ -54,13 +60,13 @@ def one(batch_size, attn_impl, remat=False, seq=512, steps=12):
     float(m["loss"])
     dt = time.perf_counter() - t0
 
-    from bench import count_params, device_peak_flops
     n_params = count_params(state["params"])
     fpt = 6 * n_params + 12 * cfg.num_layers * seq * cfg.hidden_size
     tps = batch_size * seq * steps / dt
     return {
-        "variant": f"bs{batch_size}_{attn_impl}" + ("_remat" if remat
-                                                    else ""),
+        "variant": (f"bs{batch_size}_{attn_impl}"
+                    + ("_remat" if remat else "")
+                    + ("_stacked" if stacked else "")),
         "tokens_per_sec": round(tps, 1),
         "mfu": round(tps * fpt / device_peak_flops(jax.devices()[0]), 4),
         "step_ms": round(dt / steps * 1e3, 2),
@@ -75,6 +81,7 @@ def main():
         dict(batch_size=64, attn_impl="flash"),
         dict(batch_size=96, attn_impl="flash", remat=True),
         dict(batch_size=64, attn_impl="xla"),
+        dict(batch_size=48, attn_impl="flash", stacked=True),
     ]
     if quick:
         grid = grid[:2]

@@ -17,8 +17,13 @@ import time
 import jax
 import jax.numpy as jnp
 
+# runnable as `python tools/<name>.py` from anywhere: repo root on path
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
 
 def one(batch_size, stem, remat=False, hw=224, steps=12):
+    from bench import device_peak_flops
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import dtypes
     from paddle_tpu.models.resnet import ResNet50
@@ -51,7 +56,6 @@ def one(batch_size, stem, remat=False, hw=224, steps=12):
         state, m = step(state, **batch)
     float(m["loss"])
     dt = time.perf_counter() - t0
-    from bench import device_peak_flops
     dev = jax.devices()[0]
     return {
         "variant": f"bs{batch_size}_{stem}" + ("_remat" if remat else ""),

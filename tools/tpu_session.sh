@@ -6,7 +6,7 @@
 #   BENCH_TRANSFORMER.json    Transformer-big packed varlen (config 4)
 #   BENCH_DEEPFM.json         DeepFM host-KV CTR (config 5)
 #   NATIVE_E2E.txt            the PJRT C++ runner end-to-end parity proof
-# Safe to re-run; each step is independent and fail-soft.
+# Safe to re-run: a failed step never clobbers a previously good artifact.
 set -x
 cd "$(dirname "$0")/.."
 mkdir -p tools/tpu_logs
@@ -15,26 +15,42 @@ run() {
   name="$1"; shift
   echo "== $name =="
   "$@" > "tools/tpu_logs/$name.out" 2> "tools/tpu_logs/$name.err"
-  echo "rc=$?"
+  rc=$?
+  echo "rc=$rc"
   tail -c 2000 "tools/tpu_logs/$name.out"
+  return $rc
 }
 
-run bert       timeout 1800 python bench.py
-cp tools/tpu_logs/bert.out BENCH_r04.json 2>/dev/null || true
+keep() {
+  # keep(src, dst): install src as dst — but never replace an existing
+  # good (error-free) artifact with an empty or error-bearing one
+  src="$1"; dst="$2"
+  [ -s "$src" ] || return 0
+  if [ -f "$dst" ] && grep -q '"error"' "$src" \
+      && ! grep -q '"error"' "$dst"; then
+    echo "keep: not clobbering good $dst with error result"
+    return 0
+  fi
+  cp "$src" "$dst"
+}
 
-run resnet     timeout 1800 python bench.py --model resnet50
-cp tools/tpu_logs/resnet.out BENCH_RESNET.json 2>/dev/null || true
+run bert        timeout 1800 python bench.py
+keep tools/tpu_logs/bert.out BENCH_r04.json
+
+run resnet      timeout 1800 python bench.py --model resnet50
+keep tools/tpu_logs/resnet.out BENCH_RESNET.json
 
 run transformer timeout 1800 python bench.py --model transformer
-cp tools/tpu_logs/transformer.out BENCH_TRANSFORMER.json 2>/dev/null || true
+keep tools/tpu_logs/transformer.out BENCH_TRANSFORMER.json
 
-run deepfm     timeout 1800 python bench.py --model deepfm
-cp tools/tpu_logs/deepfm.out BENCH_DEEPFM.json 2>/dev/null || true
+run deepfm      timeout 1800 python bench.py --model deepfm
+keep tools/tpu_logs/deepfm.out BENCH_DEEPFM.json
 
 # the hardware-gated native-runner parity test (must NOT skip on TPU)
-run native_e2e timeout 900 python -m pytest \
-    tests/test_native_inference.py::TestNativeExecution -q -rs
-cp tools/tpu_logs/native_e2e.out NATIVE_E2E.txt 2>/dev/null || true
+if run native_e2e timeout 900 python -m pytest \
+    tests/test_native_inference.py::TestNativeExecution -q -rs; then
+  cp tools/tpu_logs/native_e2e.out NATIVE_E2E.txt
+fi
 
 echo "session done; artifacts: BENCH_r04.json BENCH_RESNET.json \
 BENCH_TRANSFORMER.json BENCH_DEEPFM.json NATIVE_E2E.txt"
