@@ -109,6 +109,67 @@ class FasterRCNN(Layer):
 
     # ---- training --------------------------------------------------------
 
+    def _stage_losses(self, params, feat_i, score_i, delta_i, anchors,
+                      im_shape, gt_b, gt_l, gt_m):
+        """Per-image RPN + RoI-head losses. Also returns the sampled-RoI
+        auxiliaries (rois/labels/fg/matched-gt) so subclasses — the mask
+        branch — can supervise additional heads on the same sample."""
+        cfg = self.cfg
+        # --- RPN losses
+        labels, tgt, fg, bg = D.rpn_target_assign(
+            anchors, gt_b, gt_m, im_shape=im_shape,
+            batch_size_per_im=cfg.rpn_batch)
+        obj = ops_nn.sigmoid_cross_entropy_with_logits(
+            score_i, (labels == 1).astype(score_i.dtype))
+        used = labels >= 0
+        rpn_cls_l = (obj * used).sum() / jnp.maximum(used.sum(), 1)
+        rpn_reg_l = (ops_nn.smooth_l1(
+            delta_i, jax.lax.stop_gradient(tgt)).sum(-1)
+            * fg).sum() / jnp.maximum(fg.sum(), 1)
+
+        # --- proposals (gradients stop at sampled boxes)
+        rois, _, valid = D.generate_proposals(
+            jax.lax.stop_gradient(score_i),
+            jax.lax.stop_gradient(delta_i), anchors, im_shape,
+            pre_nms_top_n=cfg.pre_nms_top_n,
+            post_nms_top_n=cfg.post_nms_top_n, min_size=4.0)
+        rois = jax.lax.stop_gradient(rois)
+        # mix in gt boxes as guaranteed-quality proposals (reference
+        # generate_proposal_labels does the same)
+        rois = jnp.concatenate([rois, gt_b])
+        valid = jnp.concatenate([valid, gt_m])
+        roi_labels, roi_tgt, roi_fg, roi_bg, roi_match = \
+            D.generate_proposal_labels(
+                rois, valid, gt_b, gt_l, gt_m,
+                batch_size_per_im=cfg.roi_batch,
+                fg_fraction=cfg.fg_fraction, return_matches=True)
+
+        # --- RoI head on a FIXED roi_batch subset
+        sampled = roi_fg | roi_bg
+        order = jnp.argsort(~sampled)         # sampled first, stable
+        pick = order[:cfg.roi_batch]
+        rois_s = rois[pick]
+        lab_s = roi_labels[pick]
+        tgt_s = roi_tgt[pick]
+        use_s = sampled[pick]
+        cls_logits, reg = self._head(params, feat_i, rois_s)
+        logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), -1)
+        ce = -jnp.take_along_axis(
+            logp, jnp.maximum(lab_s, 0)[:, None], -1)[:, 0]
+        head_cls_l = (ce * use_s).sum() / jnp.maximum(use_s.sum(), 1)
+        reg = reg.reshape(cfg.roi_batch, cfg.num_classes, 4)
+        reg_sel = jnp.take_along_axis(
+            reg, jnp.maximum(lab_s, 0)[:, None, None].repeat(4, -1),
+            1)[:, 0]
+        fg_s = use_s & (lab_s > 0)
+        head_reg_l = (ops_nn.smooth_l1(
+            reg_sel, jax.lax.stop_gradient(tgt_s)).sum(-1)
+            * fg_s).sum() / jnp.maximum(fg_s.sum(), 1)
+        total = rpn_cls_l + rpn_reg_l + head_cls_l + head_reg_l
+        aux = dict(rois=rois_s, labels=lab_s, use=use_s, fg=fg_s,
+                   match=roi_match[pick])
+        return total, aux
+
     def loss(self, params, image, gt_boxes, gt_labels, gt_mask, *,
              training=True, key=None):
         """gt_boxes (B, G, 4) PIXEL xyxy; gt_labels (B, G) in [1, C)."""
@@ -119,57 +180,10 @@ class FasterRCNN(Layer):
                                jnp.float32)
 
         def one(feat_i, score_i, delta_i, gt_b, gt_l, gt_m):
-            # --- RPN losses
-            labels, tgt, fg, bg = D.rpn_target_assign(
-                anchors, gt_b, gt_m, im_shape=im_shape,
-                batch_size_per_im=cfg.rpn_batch)
-            obj = ops_nn.sigmoid_cross_entropy_with_logits(
-                score_i, (labels == 1).astype(score_i.dtype))
-            used = labels >= 0
-            rpn_cls_l = (obj * used).sum() / jnp.maximum(used.sum(), 1)
-            rpn_reg_l = (ops_nn.smooth_l1(
-                delta_i, jax.lax.stop_gradient(tgt)).sum(-1)
-                * fg).sum() / jnp.maximum(fg.sum(), 1)
-
-            # --- proposals (gradients stop at sampled boxes)
-            rois, _, valid = D.generate_proposals(
-                jax.lax.stop_gradient(score_i),
-                jax.lax.stop_gradient(delta_i), anchors, im_shape,
-                pre_nms_top_n=cfg.pre_nms_top_n,
-                post_nms_top_n=cfg.post_nms_top_n, min_size=4.0)
-            rois = jax.lax.stop_gradient(rois)
-            # mix in gt boxes as guaranteed-quality proposals (reference
-            # generate_proposal_labels does the same)
-            rois = jnp.concatenate([rois, gt_b])
-            valid = jnp.concatenate([valid, gt_m])
-            roi_labels, roi_tgt, roi_fg, roi_bg = \
-                D.generate_proposal_labels(
-                    rois, valid, gt_b, gt_l, gt_m,
-                    batch_size_per_im=cfg.roi_batch,
-                    fg_fraction=cfg.fg_fraction)
-
-            # --- RoI head on a FIXED roi_batch subset
-            sampled = roi_fg | roi_bg
-            order = jnp.argsort(~sampled)         # sampled first, stable
-            pick = order[:cfg.roi_batch]
-            rois_s = rois[pick]
-            lab_s = roi_labels[pick]
-            tgt_s = roi_tgt[pick]
-            use_s = sampled[pick]
-            cls_logits, reg = self._head(params, feat_i, rois_s)
-            logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), -1)
-            ce = -jnp.take_along_axis(
-                logp, jnp.maximum(lab_s, 0)[:, None], -1)[:, 0]
-            head_cls_l = (ce * use_s).sum() / jnp.maximum(use_s.sum(), 1)
-            reg = reg.reshape(cfg.roi_batch, cfg.num_classes, 4)
-            reg_sel = jnp.take_along_axis(
-                reg, jnp.maximum(lab_s, 0)[:, None, None].repeat(4, -1),
-                1)[:, 0]
-            fg_s = use_s & (lab_s > 0)
-            head_reg_l = (ops_nn.smooth_l1(
-                reg_sel, jax.lax.stop_gradient(tgt_s)).sum(-1)
-                * fg_s).sum() / jnp.maximum(fg_s.sum(), 1)
-            return rpn_cls_l + rpn_reg_l + head_cls_l + head_reg_l
+            total, _ = self._stage_losses(
+                params, feat_i, score_i, delta_i, anchors, im_shape,
+                gt_b, gt_l, gt_m)
+            return total
 
         losses = jax.vmap(one)(feat, scores, deltas, gt_boxes, gt_labels,
                                gt_mask)
@@ -178,9 +192,12 @@ class FasterRCNN(Layer):
     # ---- inference -------------------------------------------------------
 
     def detect(self, params, image, *, score_threshold=0.05,
-               nms_threshold=0.5, max_per_class=10):
+               nms_threshold=0.5, max_per_class=10, feat=None):
+        """``feat``: pass precomputed backbone features to share them
+        with other heads (MaskRCNN.segment computes them once)."""
         cfg = self.cfg
-        feat = self._features(params, image, training=False)
+        if feat is None:
+            feat = self._features(params, image, training=False)
         scores, deltas, anchors = self._rpn(params, feat)
         im_shape = jnp.asarray([cfg.image_size, cfg.image_size],
                                jnp.float32)
@@ -213,3 +230,93 @@ class FasterRCNN(Layer):
             return cand[idxs], cls_ids + 1, sel, ok
 
         return jax.vmap(one)(feat, scores, deltas)
+
+
+class MaskRCNN(FasterRCNN):
+    """Mask R-CNN: Faster R-CNN + a per-class mask branch
+    (PaddleCV rcnn MaskRCNN parity — reference builds the mask head as
+    RoI pool -> convs -> deconv -> 1x1 over the sampled foregrounds with
+    targets from generate_mask_labels_op; here the branch rides the same
+    sampled RoI batch ``_stage_losses`` exposes).
+
+    Mask resolution = 2 * roi_size (RoIAlign at roi_size, one stride-2
+    deconv doubles it), matching the reference's 14 -> 28 shape at the
+    standard roi_size."""
+
+    def __init__(self, cfg: FasterRCNNConfig):
+        super().__init__(cfg)
+        feat_ch = self.backbone.block_channels[self._endpoint]
+        d = cfg.head_dim
+        self.mask_conv = Conv2D(feat_ch, d, 3, padding=1)
+        self.mask_deconv = self.create_parameter(
+            "mask_deconv", (2, 2, d, d),
+            initializer=I.msra_normal(fan_in=d * 4))
+        self.mask_pred = Conv2D(d, cfg.num_classes, 1,
+                                weight_init=I.normal(std=0.01))
+        self.mask_resolution = 2 * cfg.roi_size
+
+    def _mask_head(self, params, feat_i, rois):
+        """(R, 4) rois -> per-class mask logits (R, 2s, 2s, C)."""
+        pooled = D.roi_align(
+            feat_i, rois,
+            output_size=(self.cfg.roi_size, self.cfg.roi_size),
+            spatial_scale=feat_i.shape[0] / self.cfg.image_size)
+        h = jax.nn.relu(self.mask_conv(params["mask_conv"], pooled))
+        h = ops_nn.conv2d_transpose(
+            h, params["mask_deconv"].astype(h.dtype), stride=2)
+        h = jax.nn.relu(h)
+        return self.mask_pred(params["mask_pred"], h)
+
+    def loss(self, params, image, gt_boxes, gt_labels, gt_mask,
+             gt_inst_masks, *, training=True, key=None):
+        """As FasterRCNN.loss plus ``gt_inst_masks`` (B, G, Hm, Hm)
+        binary instance rasters at image scale (square — see
+        generate_mask_labels)."""
+        cfg = self.cfg
+        feat = self._features(params, image, training)
+        scores, deltas, anchors = self._rpn(params, feat)
+        im_shape = jnp.asarray([cfg.image_size, cfg.image_size],
+                               jnp.float32)
+
+        def one(feat_i, score_i, delta_i, gt_b, gt_l, gt_m, gt_im):
+            det_l, aux = self._stage_losses(
+                params, feat_i, score_i, delta_i, anchors, im_shape,
+                gt_b, gt_l, gt_m)
+            targets, w = D.generate_mask_labels(
+                aux["rois"], aux["match"], aux["fg"], gt_im,
+                resolution=self.mask_resolution, im_size=cfg.image_size)
+            logits = self._mask_head(params, feat_i, aux["rois"])
+            cls = jnp.maximum(aux["labels"], 0)
+            sel = jnp.take_along_axis(
+                logits, cls[:, None, None, None], axis=-1)[..., 0]
+            bce = ops_nn.sigmoid_cross_entropy_with_logits(
+                sel, jax.lax.stop_gradient(targets)).mean(axis=(1, 2))
+            mask_l = (bce * w).sum() / jnp.maximum(w.sum(), 1.0)
+            return det_l + mask_l, mask_l
+
+        losses, mask_ls = jax.vmap(one)(
+            feat, scores, deltas, gt_boxes, gt_labels, gt_mask,
+            gt_inst_masks)
+        return losses.mean(), {"mask_loss": mask_ls.mean()}
+
+    def segment(self, params, image, *, score_threshold=0.05,
+                nms_threshold=0.5, max_per_class=10,
+                binarize_threshold=0.5):
+        """detect() plus a sigmoid instance mask per kept detection:
+        returns (boxes, classes, scores, valid, masks (B, K, 2s, 2s))."""
+        feat = self._features(params, image, training=False)
+        boxes, classes, det_scores, ok = self.detect(
+            params, image, score_threshold=score_threshold,
+            nms_threshold=nms_threshold, max_per_class=max_per_class,
+            feat=feat)
+
+        def one(feat_i, boxes_i, cls_i):
+            logits = self._mask_head(params, feat_i, boxes_i)
+            sel = jnp.take_along_axis(
+                logits, cls_i[:, None, None, None], axis=-1)[..., 0]
+            return jax.nn.sigmoid(sel)
+
+        probs = jax.vmap(one)(feat, boxes, classes)
+        masks = (probs >= binarize_threshold).astype(jnp.float32)
+        masks = masks * ok[:, :, None, None]
+        return boxes, classes, det_scores, ok, masks

@@ -1061,14 +1061,16 @@ def generate_proposal_labels(rois, roi_valid, gt_boxes, gt_labels,
                              gt_mask, *, batch_size_per_im=64,
                              fg_fraction=0.25, fg_thresh=0.5,
                              bg_thresh_hi=0.5, bg_thresh_lo=0.0,
-                             variances=(0.1, 0.1, 0.2, 0.2), key=None):
+                             variances=(0.1, 0.1, 0.2, 0.2), key=None,
+                             return_matches=False):
     """RCNN second-stage target sampling (generate_proposal_labels_op),
     one image: label each proposal by max-IoU gt, subsample to
     ``batch_size_per_im`` with ``fg_fraction`` foregrounds (deterministic
     hardest-first unless ``key`` supplies random tie-break like the
     reference), emit classification + regression targets. Returns
     (labels (P,) int32 [-1 = not sampled], bbox_targets (P, 4),
-    fg_mask, bg_mask)."""
+    fg_mask, bg_mask) — plus the matched gt index per proposal when
+    ``return_matches`` (what generate_mask_labels consumes)."""
     p = rois.shape[0]
     iou = box_iou(gt_boxes, rois)
     iou = jnp.where(gt_mask[:, None] & roi_valid[None, :], iou, -1.0)
@@ -1087,6 +1089,8 @@ def generate_proposal_labels(rois, roi_valid, gt_boxes, gt_labels,
                        jnp.where(bg, 0, -1)).astype(jnp.int32)
     tgt = box_encode(gt_boxes[best_gt], rois, variances)
     tgt = jnp.where(fg[:, None], tgt, 0.0)
+    if return_matches:
+        return labels, tgt, fg, bg, best_gt
     return labels, tgt, fg, bg
 
 

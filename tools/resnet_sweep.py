@@ -17,9 +17,10 @@ import time
 import jax
 import jax.numpy as jnp
 
+import os
+
 # runnable as `python tools/<name>.py` from anywhere: repo root on path
-sys.path.insert(0, __import__("os").path.dirname(
-    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def one(batch_size, stem, remat=False, hw=224, steps=12):

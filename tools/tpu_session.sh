@@ -34,17 +34,17 @@ keep() {
   cp "$src" "$dst"
 }
 
-run bert        timeout 1800 python bench.py
-keep tools/tpu_logs/bert.out BENCH_r04.json
+run bert        timeout 1800 python bench.py \
+  && keep tools/tpu_logs/bert.out BENCH_r04.json
 
-run resnet      timeout 1800 python bench.py --model resnet50
-keep tools/tpu_logs/resnet.out BENCH_RESNET.json
+run resnet      timeout 1800 python bench.py --model resnet50 \
+  && keep tools/tpu_logs/resnet.out BENCH_RESNET.json
 
-run transformer timeout 1800 python bench.py --model transformer
-keep tools/tpu_logs/transformer.out BENCH_TRANSFORMER.json
+run transformer timeout 1800 python bench.py --model transformer \
+  && keep tools/tpu_logs/transformer.out BENCH_TRANSFORMER.json
 
-run deepfm      timeout 1800 python bench.py --model deepfm
-keep tools/tpu_logs/deepfm.out BENCH_DEEPFM.json
+run deepfm      timeout 1800 python bench.py --model deepfm \
+  && keep tools/tpu_logs/deepfm.out BENCH_DEEPFM.json
 
 # the hardware-gated native-runner parity test (must NOT skip on TPU)
 if run native_e2e timeout 900 python -m pytest \
