@@ -96,16 +96,21 @@ class FasterRCNN(Layer):
             stride=(float(stride), float(stride)))
         return (scores.reshape(b, -1), deltas.reshape(b, -1, 4), anchors)
 
-    def _head(self, params, feat_i, rois):
-        pooled = D.roi_align(
+    def _pool(self, feat_i, rois):
+        return D.roi_align(
             feat_i, rois,
             output_size=(self.cfg.roi_size, self.cfg.roi_size),
             spatial_scale=feat_i.shape[0] / self.cfg.image_size)
-        flat = pooled.reshape(rois.shape[0], -1)
+
+    def _head_pooled(self, params, pooled):
+        flat = pooled.reshape(pooled.shape[0], -1)
         h = jax.nn.relu(self.fc1(params["fc1"], flat))
         h = jax.nn.relu(self.fc2(params["fc2"], h))
         return (self.cls_head(params["cls_head"], h),
                 self.reg_head(params["reg_head"], h))
+
+    def _head(self, params, feat_i, rois):
+        return self._head_pooled(params, self._pool(feat_i, rois))
 
     # ---- training --------------------------------------------------------
 
@@ -152,7 +157,8 @@ class FasterRCNN(Layer):
         lab_s = roi_labels[pick]
         tgt_s = roi_tgt[pick]
         use_s = sampled[pick]
-        cls_logits, reg = self._head(params, feat_i, rois_s)
+        pooled = self._pool(feat_i, rois_s)   # shared with the mask head
+        cls_logits, reg = self._head_pooled(params, pooled)
         logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), -1)
         ce = -jnp.take_along_axis(
             logp, jnp.maximum(lab_s, 0)[:, None], -1)[:, 0]
@@ -167,7 +173,7 @@ class FasterRCNN(Layer):
             * fg_s).sum() / jnp.maximum(fg_s.sum(), 1)
         total = rpn_cls_l + rpn_reg_l + head_cls_l + head_reg_l
         aux = dict(rois=rois_s, labels=lab_s, use=use_s, fg=fg_s,
-                   match=roi_match[pick])
+                   match=roi_match[pick], pooled=pooled)
         return total, aux
 
     def loss(self, params, image, gt_boxes, gt_labels, gt_mask, *,
@@ -255,12 +261,12 @@ class MaskRCNN(FasterRCNN):
                                 weight_init=I.normal(std=0.01))
         self.mask_resolution = 2 * cfg.roi_size
 
-    def _mask_head(self, params, feat_i, rois):
-        """(R, 4) rois -> per-class mask logits (R, 2s, 2s, C)."""
-        pooled = D.roi_align(
-            feat_i, rois,
-            output_size=(self.cfg.roi_size, self.cfg.roi_size),
-            spatial_scale=feat_i.shape[0] / self.cfg.image_size)
+    def _mask_head(self, params, feat_i, rois, pooled=None):
+        """(R, 4) rois -> per-class mask logits (R, 2s, 2s, C).
+        ``pooled``: reuse already-RoIAligned features (training shares
+        _stage_losses' pooling; RoIAlign is the gather-heavy op)."""
+        if pooled is None:
+            pooled = self._pool(feat_i, rois)
         h = jax.nn.relu(self.mask_conv(params["mask_conv"], pooled))
         h = ops_nn.conv2d_transpose(
             h, params["mask_deconv"].astype(h.dtype), stride=2)
@@ -285,7 +291,8 @@ class MaskRCNN(FasterRCNN):
             targets, w = D.generate_mask_labels(
                 aux["rois"], aux["match"], aux["fg"], gt_im,
                 resolution=self.mask_resolution, im_size=cfg.image_size)
-            logits = self._mask_head(params, feat_i, aux["rois"])
+            logits = self._mask_head(params, feat_i, aux["rois"],
+                                     pooled=aux["pooled"])
             cls = jnp.maximum(aux["labels"], 0)
             sel = jnp.take_along_axis(
                 logits, cls[:, None, None, None], axis=-1)[..., 0]

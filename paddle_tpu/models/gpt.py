@@ -70,12 +70,26 @@ class GPTBlock(Layer):
         self.mlp = FeedForward(cfg.hidden_size, cfg.ffn_size,
                                activation="gelu", dropout=cfg.dropout)
 
-    def forward(self, params, x, *, key=None, training=False):
+    def forward(self, params, x, *, key=None, training=False, cache=None,
+                cache_pos=None, return_kv=False):
         k1 = k2 = None
         if key is not None:
             k1, k2 = jax.random.split(key)
-        x = x + self.attn(params["attn"], self.ln1(params["ln1"], x),
-                          key=k1, training=training)
+        h = self.ln1(params["ln1"], x)
+        if cache is not None:
+            a, new_cache = self.attn(params["attn"], h, cache=cache,
+                                     cache_pos=cache_pos)
+            x = x + a
+            x = x + self.mlp(params["mlp"], self.ln2(params["ln2"], x))
+            return x, new_cache
+        if return_kv:
+            a, kv = self.attn(params["attn"], h, key=k1,
+                              training=training, return_kv=True)
+            x = x + a
+            x = x + self.mlp(params["mlp"], self.ln2(params["ln2"], x),
+                             key=k2, training=training)
+            return x, kv
+        x = x + self.attn(params["attn"], h, key=k1, training=training)
         x = x + self.mlp(params["mlp"], self.ln2(params["ln2"], x),
                          key=k2, training=training)
         return x
@@ -152,27 +166,105 @@ class GPT(Layer):
         loss = nll.mean()
         return loss, {"ppl": jnp.exp(loss)}
 
+    # ---- incremental decoding (KV cache) --------------------------------
+
+    def init_cache(self, batch_size, max_len, dtype=jnp.float32):
+        """Per-layer (k, v) buffers (B, H, max_len, Dh) for
+        :meth:`generate(use_cache=True)`."""
+        cfg = self.cfg
+        shape = (batch_size, cfg.num_heads, max_len,
+                 cfg.hidden_size // cfg.num_heads)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+    def prefill(self, params, ids, cache):
+        """Full-attention pass over the prompt that seeds the caches.
+        Returns (logits (B, S0, V), cache)."""
+        cfg = self.cfg
+        s0 = ids.shape[1]
+        pos = jnp.arange(s0, dtype=jnp.int32)[None, :]
+        x = self.wte(params["wte"], ids) + self.wpe(params["wpe"], pos)
+        x = _constrain(x, ACT_SPEC)
+        new_cache = []
+        for i, block in enumerate(self.blocks):
+            x, (k, v) = block(params["blocks"][str(i)], x, return_kv=True)
+            ck, cv = cache[i]
+            new_cache.append((
+                jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                             (0, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                             (0, 0, 0, 0))))
+        x = self.ln_f(params["ln_f"], x)
+        return jnp.einsum("bsd,vd->bsv", x, params["wte"]["weight"]), \
+            new_cache
+
+    def decode_step(self, params, token_ids, pos, cache):
+        """One cached decode step: ``token_ids`` (B,) at position ``pos``
+        -> (logits (B, V), new_cache). O(S) work per token versus the
+        uncached path's O(S^2) full refeed."""
+        x = (self.wte(params["wte"], token_ids[:, None])
+             + self.wpe(params["wpe"], pos[None, None]))
+        new_cache = []
+        for i, block in enumerate(self.blocks):
+            x, kv = block(params["blocks"][str(i)], x, cache=cache[i],
+                          cache_pos=pos)
+            new_cache.append(kv)
+        x = self.ln_f(params["ln_f"], x)
+        return jnp.einsum("bd,vd->bv", x[:, 0],
+                          params["wte"]["weight"]), new_cache
+
     def generate(self, params, prompt_ids, max_new_tokens=32,
-                 temperature=1.0, key=None):
+                 temperature=1.0, key=None, use_cache=False,
+                 cache_dtype=None):
         """Autoregressive sampling (greedy when key is None). Static-shape
-        loop; prompt_ids (B, S0) with S0+max_new <= max_position."""
+        loop; prompt_ids (B, S0) with S0+max_new <= max_position.
+
+        ``use_cache=True`` decodes incrementally through per-layer KV
+        caches — same tokens, O(S) per step (LayerList layout only; the
+        pipeline/stacked training layouts fall back to the full refeed).
+        ``cache_dtype`` defaults to the params' compute dtype, so a bf16
+        checkpoint gets a bf16 cache (half the HBM footprint).
+        """
+        cfg = self.cfg
         b, s0 = prompt_ids.shape
         total = s0 + max_new_tokens
         ids = jnp.concatenate(
             [prompt_ids,
              jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
 
+        def sample(logits, key):
+            # one shape for both paths: split exactly like the uncached
+            # body so cached/uncached sampling consume identical streams
+            if key is None:
+                return logits.argmax(-1).astype(jnp.int32), None
+            key, new_key = jax.random.split(key)
+            return jax.random.categorical(
+                key, logits / temperature).astype(jnp.int32), new_key
+
+        if use_cache and not (cfg.pipeline or cfg.stacked_layers):
+            if cache_dtype is None:
+                cache_dtype = params["wte"]["weight"].dtype
+            cache = self.init_cache(b, total, dtype=cache_dtype)
+            logits, cache = self.prefill(params, prompt_ids, cache)
+            nxt, key = sample(logits[:, s0 - 1], key)
+            ids = ids.at[:, s0].set(nxt)
+
+            def body(t, carry):
+                ids, cache, key = carry
+                logits, cache = self.decode_step(
+                    params, ids[:, t - 1], jnp.asarray(t - 1), cache)
+                nxt, key = sample(logits, key)
+                return ids.at[:, t].set(nxt), cache, key
+
+            ids, _, _ = jax.lax.fori_loop(s0 + 1, total, body,
+                                          (ids, cache, key))
+            return ids
+
         def body(t, carry):
             ids, key = carry
             logits = self.forward(params, ids)[:, t - 1]
-            if key is None:
-                nxt = logits.argmax(-1).astype(jnp.int32)
-                new_key = None
-            else:
-                key, new_key = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    key, logits / temperature).astype(jnp.int32)
-            return ids.at[:, t].set(nxt), new_key
+            nxt, key = sample(logits, key)
+            return ids.at[:, t].set(nxt), key
 
         ids, _ = jax.lax.fori_loop(s0, total, body, (ids, key))
         return ids

@@ -80,9 +80,18 @@ class MultiHeadAttention(Layer):
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
     def forward(self, params, query, key_value=None, *, bias=None,
-                key=None, training=False):
+                key=None, training=False, cache=None, cache_pos=None,
+                return_kv=False):
         """query: (B, Sq, D); key_value: (B, Sk, D) for cross-attention.
-        ``bias``: additive attention bias broadcastable to (B,H,Sq,Sk)."""
+        ``bias``: additive attention bias broadcastable to (B,H,Sq,Sk).
+
+        Incremental decoding: ``cache=(k_cache, v_cache)`` with leaves
+        (B, H, Smax, Dh) and ``cache_pos`` the write position makes this
+        a single-token decode step (query Sq=1 attends over the filled
+        prefix; O(S) per token instead of refeeding the whole sequence)
+        returning (out, new_cache). ``return_kv=True`` on the normal
+        path additionally returns this call's (k, v) heads — the
+        prefill that seeds the cache."""
         if self.self_attention:
             qkv = self.qkv_proj(params["qkv_proj"], query)
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -92,6 +101,25 @@ class MultiHeadAttention(Layer):
                               query if key_value is None else key_value)
             k, v = jnp.split(kv, 2, axis=-1)
         q, k, v = (self._split_heads(t) for t in (q, k, v))
+
+        if cache is not None:
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, 0, cache_pos, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, 0, cache_pos, 0))
+            # static shapes: attend over the whole cache, mask the unfilled
+            # tail (positions > cache_pos)
+            smax = ck.shape[2]
+            mask = jnp.arange(smax)[None, None, None, :] <= cache_pos
+            step_bias = jnp.where(mask, 0.0, -1e30).astype(q.dtype)
+            if bias is not None:
+                step_bias = step_bias + bias
+            out = ops_attn.dot_product_attention(
+                q, ck, cv, bias=step_bias, causal=False, impl="xla")
+            out = self._merge_heads(out)
+            out = self.out_proj(params["out_proj"], out)
+            return out, (ck, cv)
         spec = RING_HEADS_SPEC if self.attn_impl == "ring" else HEADS_SPEC
         q = _constrain(q, spec)
         k = _constrain(k, spec)
@@ -107,7 +135,10 @@ class MultiHeadAttention(Layer):
                 dropout_rate=drop_rate, dropout_key=key, impl=self.attn_impl)
         out = self._merge_heads(out)
         out = self.out_proj(params["out_proj"], out)
-        return _constrain(out, ACT_SPEC)
+        out = _constrain(out, ACT_SPEC)
+        if return_kv:
+            return out, (k, v)
+        return out
 
 
 class FeedForward(Layer):
