@@ -237,10 +237,59 @@ class Transformer(Layer):
         loss = tok_sum / jnp.maximum(tok_count, 1.0)
         return loss, {"token_sum": tok_sum, "token_count": tok_count}
 
-    def greedy_decode(self, params, src_ids, max_len=None):
-        """Greedy generation (≙ reference beam_search with beam=1; full
-        beam search is an inference-path follow-up). Re-runs the decoder
-        per step under lax.while_loop — O(S^2) but static-shaped."""
+    # ---- cached incremental decoding ------------------------------------
+
+    def _decode_state(self, params, memory, max_len, beam_expand=1):
+        """Per-layer state for cached decoding: empty self-attention KV
+        buffers + cross-attention heads precomputed ONCE from the
+        UNexpanded ``memory`` and then repeated ``beam_expand`` times
+        (beam search must not pay beam_size x the kv projections)."""
+        cfg = self.cfg
+        dh = cfg.d_model // cfg.num_heads
+        dtype = memory.dtype
+        batch = memory.shape[0] * beam_expand
+        caches, cross = [], []
+        for i, layer in enumerate(self.decoder):
+            z = jnp.zeros((batch, cfg.num_heads, max_len, dh), dtype)
+            caches.append((z, z))
+            k, v = layer.cross_attn.cross_kv(
+                params["decoder"][str(i)]["cross_attn"], memory)
+            if beam_expand > 1:
+                k = jnp.repeat(k, beam_expand, axis=0)
+                v = jnp.repeat(v, beam_expand, axis=0)
+            cross.append((k, v))
+        return caches, cross
+
+    def _cached_step(self, params, tok, t, caches, cross, memory_bias,
+                     table_len):
+        """tok (B,) at position ``t`` -> (logits (B, V), new caches)."""
+        cfg = self.cfg
+        x = self.embed(params["embed"], tok[:, None]) * math.sqrt(
+            cfg.d_model)
+        # size the table by the caller's horizon: dynamic_index CLAMPS
+        # out-of-range t, which would silently reuse the last position
+        # (same guard as _embed_packed)
+        table = sinusoid_positions(max(cfg.max_len, table_len),
+                                   cfg.d_model)
+        x = x + jax.lax.dynamic_index_in_dim(table, t, keepdims=True)
+        new_caches = []
+        for i, layer in enumerate(self.decoder):
+            x, kv = layer.decode_step(
+                params["decoder"][str(i)], x, t, caches[i], cross[i],
+                cross_bias=memory_bias)
+            new_caches.append(kv)
+        if cfg.pre_ln:
+            x = self.dec_ln(params["dec_ln"], x)
+        logits = jnp.einsum("bd,vd->bv", x[:, 0],
+                            params["embed"]["weight"])
+        return logits, new_caches
+
+    def greedy_decode(self, params, src_ids, max_len=None,
+                      use_cache=True):
+        """Greedy generation (≙ reference beam_search with beam=1).
+        ``use_cache=True`` (default) decodes through per-layer self-attn
+        KV caches + precomputed cross-attn memory heads — O(S) per
+        token; the uncached path refeeds the whole prefix each step."""
         cfg = self.cfg
         max_len = max_len or cfg.max_len
         b = src_ids.shape[0]
@@ -248,6 +297,28 @@ class Transformer(Layer):
         tgt = jnp.full((b, max_len), cfg.pad_id, jnp.int32)
         tgt = tgt.at[:, 0].set(cfg.bos_id)
         done = jnp.zeros((b,), bool)
+
+        if use_cache:
+            caches, cross = self._decode_state(params, memory, max_len)
+
+            def cond(carry):
+                t, _, done, _ = carry
+                return (t < max_len - 1) & ~jnp.all(done)
+
+            def body(carry):
+                t, tgt, done, caches = carry
+                logits, caches = self._cached_step(
+                    params, tgt[:, t], t, caches, cross, memory_bias,
+                    max_len)
+                nxt = logits.argmax(-1).astype(jnp.int32)
+                nxt = jnp.where(done, cfg.pad_id, nxt)
+                tgt = tgt.at[:, t + 1].set(nxt)
+                done = done | (nxt == cfg.eos_id)
+                return t + 1, tgt, done, caches
+
+            _, tgt, _, _ = jax.lax.while_loop(
+                cond, body, (0, tgt, done, caches))
+            return tgt
 
         def cond(carry):
             t, _, done = carry
@@ -267,10 +338,16 @@ class Transformer(Layer):
 
     def beam_search_decode(self, params, src_ids, *, beam_size: int = 4,
                            max_len: Optional[int] = None,
-                           length_penalty: float = 0.6):
+                           length_penalty: float = 0.6,
+                           use_cache: bool = True):
         """Beam search (reference ``beam_search_op`` + ``layers.beam_search``
         machine-translation path). GNMT-style length normalization
-        ((5+len)/6)^alpha. Returns (best_ids (B, T), best_scores (B,))."""
+        ((5+len)/6)^alpha. Returns (best_ids (B, T), best_scores (B,)).
+
+        ``use_cache=True`` (default) decodes through beam-expanded KV
+        caches, reordered alongside the beams at every step — the
+        reference's cached beam decoder; the uncached path refeeds
+        every prefix each step."""
         cfg = self.cfg
         max_len = max_len or cfg.max_len
         b = src_ids.shape[0]
@@ -292,15 +369,15 @@ class Transformer(Layer):
         def penalty(length):
             return ((5.0 + length) / 6.0) ** length_penalty
 
-        def body(t, carry):
-            tgt, scores, done = carry
-            logits = self.decode(params, tgt.reshape(b * k, max_len),
-                                 mem, mem_bias)[:, t]          # (B*K, V)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        def select(logits_t, t, tgt, scores, done):
+            """Shared beam bookkeeping. logits_t (B*K, V) at step t.
+            Returns (tgt, scores, done, src_beam)."""
+            logp = jax.nn.log_softmax(logits_t.astype(jnp.float32), -1)
             logp = logp.reshape(b, k, v)
             # finished beams: only PAD continuation, score unchanged
             pad_only = jnp.full((v,), NEG).at[cfg.pad_id].set(0.0)
-            logp = jnp.where(done[..., None], pad_only[None, None, :], logp)
+            logp = jnp.where(done[..., None], pad_only[None, None, :],
+                             logp)
             cand = scores[..., None] + logp                    # (B, K, V)
             flat = cand.reshape(b, k * v)
             new_scores, idx = jax.lax.top_k(flat, k)           # (B, K)
@@ -310,10 +387,44 @@ class Transformer(Layer):
             tgt = tgt.at[:, :, t + 1].set(tok)
             done = jnp.take_along_axis(done, src_beam, axis=1)
             done = done | (tok == cfg.eos_id)
-            return tgt, new_scores, done
+            return tgt, new_scores, done, src_beam
 
-        tgt, scores, done = jax.lax.fori_loop(
-            0, max_len - 1, body, (tgt, scores, done))
+        if use_cache:
+            caches, cross = self._decode_state(params, memory, max_len,
+                                               beam_expand=k)
+
+            def reorder(cache_leaf, src_beam):
+                # (B*K, ...) rows follow their beams
+                shaped = cache_leaf.reshape((b, k) + cache_leaf.shape[1:])
+                ix = src_beam.reshape(
+                    (b, k) + (1,) * (cache_leaf.ndim - 1))
+                shaped = jnp.take_along_axis(shaped, ix, axis=1)
+                return shaped.reshape(cache_leaf.shape)
+
+            def body(t, carry):
+                tgt, scores, done, caches = carry
+                logits, caches = self._cached_step(
+                    params, tgt.reshape(b * k, max_len)[:, t], t,
+                    caches, cross, mem_bias, max_len)
+                tgt, scores, done, src_beam = select(
+                    logits, t, tgt, scores, done)
+                caches = jax.tree_util.tree_map(
+                    lambda a: reorder(a, src_beam), caches)
+                return tgt, scores, done, caches
+
+            tgt, scores, done, _ = jax.lax.fori_loop(
+                0, max_len - 1, body, (tgt, scores, done, caches))
+        else:
+            def body(t, carry):
+                tgt, scores, done = carry
+                logits = self.decode(params, tgt.reshape(b * k, max_len),
+                                     mem, mem_bias)[:, t]      # (B*K, V)
+                tgt, scores, done, _ = select(logits, t, tgt, scores,
+                                              done)
+                return tgt, scores, done
+
+            tgt, scores, done = jax.lax.fori_loop(
+                0, max_len - 1, body, (tgt, scores, done))
         # length-normalized final ranking
         lengths = (tgt != cfg.pad_id).sum(-1).astype(jnp.float32)
         norm = scores / penalty(lengths)

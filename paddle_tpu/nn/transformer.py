@@ -79,9 +79,18 @@ class MultiHeadAttention(Layer):
         b, h, s, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
+    def cross_kv(self, params, memory):
+        """Precompute cross-attention (k, v) heads from encoder memory —
+        done ONCE per sequence; decode steps pass them as ``static_kv``
+        (the reference's cached beam-search decoder keeps the same
+        per-layer static caches)."""
+        kv = self.kv_proj(params["kv_proj"], memory)
+        k, v = jnp.split(kv, 2, axis=-1)
+        return self._split_heads(k), self._split_heads(v)
+
     def forward(self, params, query, key_value=None, *, bias=None,
                 key=None, training=False, cache=None, cache_pos=None,
-                return_kv=False):
+                return_kv=False, static_kv=None):
         """query: (B, Sq, D); key_value: (B, Sk, D) for cross-attention.
         ``bias``: additive attention bias broadcastable to (B,H,Sq,Sk).
 
@@ -91,7 +100,16 @@ class MultiHeadAttention(Layer):
         prefix; O(S) per token instead of refeeding the whole sequence)
         returning (out, new_cache). ``return_kv=True`` on the normal
         path additionally returns this call's (k, v) heads — the
-        prefill that seeds the cache."""
+        prefill that seeds the cache. ``static_kv``: precomputed (k, v)
+        heads (see :meth:`cross_kv`) — skips the kv projection entirely
+        (cross-attention decode)."""
+        if static_kv is not None:
+            q = self._split_heads(self.q_proj(params["q_proj"], query))
+            k, v = static_kv
+            out = ops_attn.dot_product_attention(
+                q, k, v, bias=bias, causal=False, impl="xla")
+            out = self._merge_heads(out)
+            return self.out_proj(params["out_proj"], out)
         if self.self_attention:
             qkv = self.qkv_proj(params["qkv_proj"], query)
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -250,3 +268,30 @@ class TransformerDecoderLayer(Layer):
                 lambda h: self.ffn(params["ffn"], h, key=ks[2],
                                    training=training), ks[2])
         return x
+
+    def decode_step(self, params, x, pos, self_cache, cross_kv, *,
+                    cross_bias=None):
+        """Single-token cached decode (x (B, 1, D) at position ``pos``):
+        self-attention through the KV cache, cross-attention over the
+        precomputed memory heads. Inference only (no dropout). Returns
+        (x, new_self_cache)."""
+        def sub(x, ln_name, fn):
+            ln = getattr(self, ln_name)
+            if self.pre_ln:
+                return x + fn(ln(params[ln_name], x))
+            return ln(params[ln_name], x + fn(x))
+
+        box = {}
+
+        def self_fn(h):
+            out, box["cache"] = self.self_attn(
+                params["self_attn"], h, cache=self_cache, cache_pos=pos)
+            return out
+
+        x = sub(x, "ln1", self_fn)
+        x = sub(x, "ln2",
+                lambda h: self.cross_attn(params["cross_attn"], h,
+                                          bias=cross_bias,
+                                          static_kv=cross_kv))
+        x = sub(x, "ln3", lambda h: self.ffn(params["ffn"], h))
+        return x, box["cache"]

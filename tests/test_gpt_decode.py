@@ -75,3 +75,65 @@ class TestCachedDecode:
                              use_cache=True)
         ref = model.generate(params, prompt, max_new_tokens=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestTransformerCachedDecode:
+    """Cached greedy/beam decoding parity for the seq2seq Transformer."""
+
+    def _model(self):
+        from paddle_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        cfg = TransformerConfig.tiny(dropout=0.0, attn_dropout=0.0,
+                                     max_len=16, attn_impl="xla")
+        m = Transformer(cfg)
+        return m, m.init(jax.random.PRNGKey(0)), cfg
+
+    def test_greedy_cached_matches_uncached(self):
+        m, params, cfg = self._model()
+        src = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 3,
+                                 cfg.vocab_size)
+        fast = jax.jit(lambda p, s: m.greedy_decode(p, s))(params, src)
+        slow = jax.jit(lambda p, s: m.greedy_decode(
+            p, s, use_cache=False))(params, src)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+    def test_beam_cached_matches_uncached(self):
+        m, params, cfg = self._model()
+        src = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 3,
+                                 cfg.vocab_size)
+        ids_f, sc_f = jax.jit(lambda p, s: m.beam_search_decode(
+            p, s, beam_size=3))(params, src)
+        ids_s, sc_s = jax.jit(lambda p, s: m.beam_search_decode(
+            p, s, beam_size=3, use_cache=False))(params, src)
+        np.testing.assert_array_equal(np.asarray(ids_f),
+                                      np.asarray(ids_s))
+        np.testing.assert_allclose(np.asarray(sc_f), np.asarray(sc_s),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_post_ln_variant(self):
+        from paddle_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        cfg = TransformerConfig.tiny(dropout=0.0, attn_dropout=0.0,
+                                     max_len=12, attn_impl="xla",
+                                     pre_ln=False)
+        m = Transformer(cfg)
+        params = m.init(jax.random.PRNGKey(5))
+        src = jax.random.randint(jax.random.PRNGKey(6), (1, 6), 3,
+                                 cfg.vocab_size)
+        fast = m.greedy_decode(params, src)
+        slow = m.greedy_decode(params, src, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+    def test_horizon_beyond_cfg_max_len(self):
+        """max_len above cfg.max_len must not clamp cached positions."""
+        from paddle_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        cfg = TransformerConfig.tiny(dropout=0.0, attn_dropout=0.0,
+                                     max_len=8, attn_impl="xla")
+        m = Transformer(cfg)
+        params = m.init(jax.random.PRNGKey(7))
+        src = jax.random.randint(jax.random.PRNGKey(8), (1, 6), 3,
+                                 cfg.vocab_size)
+        fast = m.greedy_decode(params, src, max_len=14)
+        slow = m.greedy_decode(params, src, max_len=14, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
