@@ -184,12 +184,9 @@ class BertModel(Layer):
 
         cfg = self.cfg
         M = cfg.pp_microbatches
-        b = x.shape[0]
         extras = extras_spec = None
         if bias is not None:
-            extras = bias.reshape((M, b // M) + bias.shape[1:])
-            extras_spec = P(*((None, ("dp", "fsdp"))
-                              + (None,) * (extras.ndim - 2)))
+            extras, extras_spec = pp_lib.microbatch_extras(bias, M)
 
         if cfg.stacked_layers:
             block_layer = self.encoder.template

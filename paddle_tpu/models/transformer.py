@@ -111,23 +111,21 @@ class Transformer(Layer):
 
     def _mb_extras(self, tree):
         """Microbatch (B, ...) extras to (M, mb, ...) + matching specs."""
-        from jax.sharding import PartitionSpec as P
-
         from paddle_tpu.parallel import pipeline as pp_lib
 
-        out = pp_lib.microbatch(tree, self.cfg.pp_microbatches)
-        return out, jax.tree_util.tree_map(
-            lambda a: P(*((None, ("dp", "fsdp"))
-                          + (None,) * (a.ndim - 2))), out)
+        return pp_lib.microbatch_extras(tree, self.cfg.pp_microbatches)
 
-    def encode(self, params, src_ids, *, key=None, training=False):
+    def encode(self, params, src_ids, *, key=None, training=False,
+               pipelined=None):
         cfg = self.cfg
+        if pipelined is None:
+            pipelined = cfg.pipeline
         src_mask = src_ids != cfg.pad_id
         bias = ops_attn.make_padding_bias(src_mask)
         keys = ([None] * (cfg.num_encoder_layers + 1) if key is None
                 else list(jax.random.split(key, cfg.num_encoder_layers + 1)))
         x = self._embed(params, src_ids, keys[0], training)
-        if cfg.pipeline:
+        if pipelined:
             from paddle_tpu.parallel import pipeline as pp_lib
 
             extras, extras_spec = self._mb_extras(bias)
@@ -149,12 +147,14 @@ class Transformer(Layer):
         return x, bias
 
     def decode(self, params, tgt_ids, memory, memory_bias, *, key=None,
-               training=False):
+               training=False, pipelined=None):
         cfg = self.cfg
+        if pipelined is None:
+            pipelined = cfg.pipeline
         keys = ([None] * (cfg.num_decoder_layers + 1) if key is None
                 else list(jax.random.split(key, cfg.num_decoder_layers + 1)))
         x = self._embed(params, tgt_ids, keys[0], training)
-        if cfg.pipeline:
+        if pipelined:
             from paddle_tpu.parallel import pipeline as pp_lib
 
             # the encoder memory + its padding bias ride the ring with
@@ -350,7 +350,10 @@ class Transformer(Layer):
         cfg = self.cfg
         max_len = max_len or cfg.max_len
         b = src_ids.shape[0]
-        memory, memory_bias = self.encode(params, src_ids)
+        # inference: always the sequential stacks — the pipelined path
+        # needs a pp mesh + microbatch-divisible batch (training shape)
+        memory, memory_bias = self.encode(params, src_ids,
+                                          pipelined=False)
         tgt = jnp.full((b, max_len), cfg.pad_id, jnp.int32)
         tgt = tgt.at[:, 0].set(cfg.bos_id)
         done = jnp.zeros((b,), bool)
@@ -383,7 +386,8 @@ class Transformer(Layer):
 
         def body(carry):
             t, tgt, done = carry
-            logits = self.decode(params, tgt, memory, memory_bias)
+            logits = self.decode(params, tgt, memory, memory_bias,
+                                 pipelined=False)
             nxt = logits[:, t].argmax(-1).astype(jnp.int32)
             nxt = jnp.where(done, cfg.pad_id, nxt)
             tgt = tgt.at[:, t + 1].set(nxt)
@@ -412,7 +416,8 @@ class Transformer(Layer):
         v = cfg.vocab_size
         NEG = -1e9
 
-        memory, memory_bias = self.encode(params, src_ids)
+        memory, memory_bias = self.encode(params, src_ids,
+                                          pipelined=False)
         # expand memory to beams: (B*K, S, D)
         mem = jnp.repeat(memory, k, axis=0)
         mem_bias = jnp.repeat(memory_bias, k, axis=0)
@@ -475,7 +480,8 @@ class Transformer(Layer):
             def body(t, carry):
                 tgt, scores, done = carry
                 logits = self.decode(params, tgt.reshape(b * k, max_len),
-                                     mem, mem_bias)[:, t]      # (B*K, V)
+                                     mem, mem_bias,
+                                     pipelined=False)[:, t]    # (B*K, V)
                 tgt, scores, done, _ = select(logits, t, tgt, scores,
                                               done)
                 return tgt, scores, done

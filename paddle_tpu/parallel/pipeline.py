@@ -120,23 +120,23 @@ def gpipe(
         T = M + n - 1
         perm = [(i, i + 1) for i in range(n - 1)]
         recv_h = jnp.zeros(x.shape[1:], x.dtype)
-        recv_e = jax.tree_util.tree_map(
-            lambda a: jnp.zeros(a.shape[1:], a.dtype), extras)
         recv_mb = jnp.zeros((), jnp.int32)
         outputs = jnp.zeros_like(x)
 
         def tick(t, carry):
-            (recv_h, recv_e, recv_mb), outputs = carry
+            (recv_h, recv_mb), outputs = carry
             feed_at = jnp.clip(t, 0, M - 1)
             feed_h = jax.lax.dynamic_index_in_dim(x, feed_at, keepdims=False)
-            feed_e = jax.tree_util.tree_map(
-                lambda a: jax.lax.dynamic_index_in_dim(a, feed_at,
-                                                       keepdims=False),
-                extras)
             inp_h = jnp.where(is_first, feed_h, recv_h)
-            inp_e = jax.tree_util.tree_map(
-                lambda f, r: jnp.where(is_first, f, r), feed_e, recv_e)
             inp_mb = jnp.where(is_first, feed_at, recv_mb)
+            # extras are replicated over "pp": every stage indexes its
+            # microbatch's extra locally by the mb index that rides the
+            # ring — only the scalar hops, never the (possibly
+            # activation-sized) extra itself
+            inp_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.clip(inp_mb, 0, M - 1), keepdims=False),
+                extras)
             h = local_stage(local_params, inp_h, inp_e, inp_mb)
             mb_idx = t - s          # microbatch this stage just computed
             active = (mb_idx >= 0) & (mb_idx < M)
@@ -144,13 +144,11 @@ def gpipe(
             outputs = _masked_row_update(outputs, write_at, h,
                                          active & is_last)
             recv_h = jax.lax.ppermute(h, axis, perm)
-            recv_e = jax.tree_util.tree_map(
-                lambda a: jax.lax.ppermute(a, axis, perm), inp_e)
             recv_mb = jax.lax.ppermute(inp_mb, axis, perm)
-            return ((recv_h, recv_e, recv_mb), outputs)
+            return ((recv_h, recv_mb), outputs)
 
         _, outputs = jax.lax.fori_loop(
-            0, T, tick, ((recv_h, recv_e, recv_mb), outputs))
+            0, T, tick, ((recv_h, recv_mb), outputs))
         # outputs are only valid on the last stage: replicate via psum
         outputs = jnp.where(is_last, outputs, 0.0)
         return jax.lax.psum(outputs, axis)
@@ -283,10 +281,8 @@ def circular_pipeline(
         T = v * M + n - 1
         ring = [(i, (i + 1) % n) for i in range(n)]
         zero_h = jnp.zeros(x.shape[1:], x.dtype)
-        zero_e = jax.tree_util.tree_map(
-            lambda a: jnp.zeros(a.shape[1:], a.dtype), extras)
         carry = dict(
-            recv_h=zero_h, recv_e=zero_e, recv_mb=jnp.zeros((), jnp.int32),
+            recv_h=zero_h, recv_mb=jnp.zeros((), jnp.int32),
             buf=jnp.zeros_like(x),        # stage-0 inter-circuit slots
             outputs=jnp.zeros_like(x),
         )
@@ -321,14 +317,14 @@ def circular_pipeline(
                 c == 0,
                 jax.lax.dynamic_index_in_dim(x, m, keepdims=False),
                 jax.lax.dynamic_index_in_dim(buf, m, keepdims=False))
-            feed_e = jax.tree_util.tree_map(
-                lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
-                extras)
             inp_h = jnp.where(is_first, feed_h, carry["recv_h"])
-            inp_e = jax.tree_util.tree_map(
-                lambda f, r: jnp.where(is_first, f, r), feed_e,
-                carry["recv_e"])
             inp_mb = jnp.where(is_first, m, carry["recv_mb"])
+            # extras are pp-replicated: index locally by the riding mb
+            # index instead of shipping the extra itself over the ring
+            inp_e = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.clip(inp_mb, 0, M - 1), keepdims=False),
+                extras)
 
             # -- compute this device's chunk for the item it holds
             my_c = jnp.clip((t - s), 0, v * M - 1) // M
@@ -337,8 +333,6 @@ def circular_pipeline(
             # -- ring hop
             return dict(
                 recv_h=jax.lax.ppermute(h, axis, ring),
-                recv_e=jax.tree_util.tree_map(
-                    lambda a: jax.lax.ppermute(a, axis, ring), inp_e),
                 recv_mb=jax.lax.ppermute(inp_mb, axis, ring),
                 buf=buf, outputs=outputs)
 
@@ -445,6 +439,20 @@ def microbatch(batch, num_microbatches: int):
     """(B, ...) -> (M, B/M, ...) over every leaf."""
     return jax.tree_util.tree_map(
         lambda x: x.reshape((num_microbatches, -1) + x.shape[1:]), batch)
+
+
+def microbatch_extras(tree, num_microbatches: int):
+    """Microbatch per-example side inputs for the pipeline schedules and
+    build their PartitionSpecs: (B, ...) -> (M, B/M, ...) with the
+    microbatch-local batch dim sharded over (dp, fsdp) and everything
+    else replicated (extras never shard over "pp" — stages index them
+    locally by the riding microbatch index). Shared by the BERT and
+    Transformer pipeline paths."""
+    out = microbatch(tree, num_microbatches)
+    specs = jax.tree_util.tree_map(
+        lambda a: P(*((None, ("dp", "fsdp")) + (None,) * (a.ndim - 2))),
+        out)
+    return out, specs
 
 
 def unmicrobatch(batch):

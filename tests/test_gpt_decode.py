@@ -5,6 +5,7 @@ decoders — decoding cost per token drops from O(S^2) to O(S))."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu.models.gpt import GPT, GPTConfig
 
@@ -28,6 +29,7 @@ class TestCachedDecode:
                                    np.asarray(logits_full),
                                    atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_decode_step_matches_full_forward(self):
         model, params = _model()
         ids = jax.random.randint(jax.random.PRNGKey(2), (2, 9), 0, 64)
@@ -49,6 +51,7 @@ class TestCachedDecode:
             p, i, max_new_tokens=10, use_cache=True))(params, prompt)
         np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
 
+    @pytest.mark.slow
     def test_sampled_generate_parity(self):
         """Same PRNG key must give identical samples on both paths (the
         split pattern is shared)."""
@@ -110,6 +113,7 @@ class TestTransformerCachedDecode:
         np.testing.assert_allclose(np.asarray(sc_f), np.asarray(sc_s),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_post_ln_variant(self):
         from paddle_tpu.models.transformer import (Transformer,
                                                    TransformerConfig)
@@ -137,3 +141,38 @@ class TestTransformerCachedDecode:
         fast = m.greedy_decode(params, src, max_len=14)
         slow = m.greedy_decode(params, src, max_len=14, use_cache=False)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+    @pytest.mark.slow
+    def test_cached_decode_exports_and_serves(self, tmp_path):
+        """The cached while_loop decoder survives StableHLO export ->
+        Predictor round trip (the translation-serving artifact,
+        save_inference_model parity for generation graphs)."""
+        from paddle_tpu import inference
+        m, params, cfg = self._model()
+        src = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(9), (2, 10), 3, cfg.vocab_size),
+            np.int32)
+        ref = np.asarray(m.greedy_decode(params, jnp.asarray(src)))
+        d = str(tmp_path / "mt")
+        inference.save_inference_model(
+            d, lambda p, s: m.greedy_decode(p, s), params, [src])
+        out = np.asarray(inference.Predictor(d).run(src))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_pipeline_model_decodes_without_mesh(self):
+        """A pipeline-trained Transformer must serve (greedy/beam) with
+        arbitrary batch sizes and NO pp mesh — decoding always uses the
+        sequential stacks."""
+        from paddle_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        cfg = TransformerConfig.tiny(dropout=0.0, attn_dropout=0.0,
+                                     max_len=12, attn_impl="xla",
+                                     pipeline=True, pp_microbatches=2)
+        m = Transformer(cfg)
+        params = m.init(jax.random.PRNGKey(11))
+        src = jax.random.randint(jax.random.PRNGKey(12), (1, 5), 3,
+                                 cfg.vocab_size)      # batch 1, no mesh
+        out = m.greedy_decode(params, src)
+        assert out.shape == (1, 12)
+        ids, scores = m.beam_search_decode(params, src, beam_size=2)
+        assert ids.shape == (1, 12) and scores.shape == (1,)

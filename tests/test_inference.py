@@ -6,6 +6,7 @@ tests in the book suite (test_recognize_digits saves and re-serves)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu import inference
 from paddle_tpu.models.lenet import LeNet
@@ -155,6 +156,7 @@ class TestInt8Serving:
                 params, [x], weight_quantize="int4")
 
 
+@pytest.mark.slow
 class TestConvBNFolding:
     """conv_bn_fuse_pass parity (framework/ir/conv_bn_fuse_pass.cc):
     folding BN into conv weights preserves the eval function exactly."""
