@@ -1,0 +1,227 @@
+"""Classic PaddleCV image_classification zoo tail: AlexNet, GoogLeNet
+(Inception v1), ShuffleNetV2 — NHWC/TPU-native builds of the remaining
+reference classification families (reference models live in the
+PaddleCV models/image_classification zoo built on fluid layers/nn.py
+conv2d/pool2d/fc; here they compose the same nn.layers primitives).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layers import Conv2D, Linear, Pool2D
+from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.models.resnet import ConvBNLayer
+from paddle_tpu.ops import nn as F
+
+
+class AlexNet(Layer):
+    """AlexNet (5 conv + 3 fc, LRN replaced by the modern BN idiom is
+    NOT applied — the classic net uses plain conv+relu like the
+    reference's AlexNet)."""
+
+    def __init__(self, num_classes=1000, in_ch=3):
+        super().__init__()
+        self.conv1 = Conv2D(in_ch, 64, 11, stride=4, padding=2)
+        self.conv2 = Conv2D(64, 192, 5, padding=2)
+        self.conv3 = Conv2D(192, 384, 3, padding=1)
+        self.conv4 = Conv2D(384, 256, 3, padding=1)
+        self.conv5 = Conv2D(256, 256, 3, padding=1)
+        self.pool = Pool2D(3, stride=2, pool_type="max")
+        self.fc1 = Linear(256 * 6 * 6, 4096, sharding=None)
+        self.fc2 = Linear(4096, 4096, sharding=None)
+        self.fc3 = Linear(4096, num_classes, sharding=None)
+
+    def forward(self, params, x, *, training=False, key=None):
+        for name in ("conv1", "conv2"):
+            x = jax.nn.relu(getattr(self, name)(params[name], x))
+            x = self.pool(None, x)
+        for name in ("conv3", "conv4", "conv5"):
+            x = jax.nn.relu(getattr(self, name)(params[name], x))
+        x = self.pool(None, x)
+        # adaptive 6x6 like the canonical head (no-op for 224 inputs;
+        # bilinear resample covers non-divisible test shapes)
+        if x.shape[1:3] != (6, 6):
+            if x.shape[1] % 6 == 0 and x.shape[2] % 6 == 0:
+                x = F.adaptive_pool2d(x, 6, pool_type="avg")
+            else:
+                x = jax.image.resize(
+                    x, (x.shape[0], 6, 6, x.shape[3]), "linear")
+        x = x.reshape(x.shape[0], -1)
+        ks = ([None, None] if key is None
+              else list(jax.random.split(key, 2)))
+        x = jax.nn.relu(self.fc1(params["fc1"], x))
+        x = F.dropout(x, ks[0], rate=0.5,
+                      training=training and ks[0] is not None)
+        x = jax.nn.relu(self.fc2(params["fc2"], x))
+        x = F.dropout(x, ks[1], rate=0.5,
+                      training=training and ks[1] is not None)
+        return self.fc3(params["fc3"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training, key=key),
+            label)
+
+
+class _Inception(Layer):
+    """GoogLeNet inception block: 1x1 | 1x1-3x3 | 1x1-5x5 | pool-1x1."""
+
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, cp):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_ch, c1, 1, act="relu")
+        self.b3r = ConvBNLayer(in_ch, c3r, 1, act="relu")
+        self.b3 = ConvBNLayer(c3r, c3, 3, act="relu")
+        self.b5r = ConvBNLayer(in_ch, c5r, 1, act="relu")
+        self.b5 = ConvBNLayer(c5r, c5, 5, act="relu")
+        self.bp = ConvBNLayer(in_ch, cp, 1, act="relu")
+        self.pool = Pool2D(3, stride=1, padding=1, pool_type="max")
+        self.out_ch = c1 + c3 + c5 + cp
+
+    def forward(self, params, x, training=False):
+        y1 = self.b1(params["b1"], x, training=training)
+        y3 = self.b3(params["b3"],
+                     self.b3r(params["b3r"], x, training=training),
+                     training=training)
+        y5 = self.b5(params["b5"],
+                     self.b5r(params["b5r"], x, training=training),
+                     training=training)
+        yp = self.bp(params["bp"], self.pool(None, x),
+                     training=training)
+        return jnp.concatenate([y1, y3, y5, yp], axis=-1)
+
+
+class GoogLeNet(Layer):
+    """GoogLeNet / Inception v1 (PaddleCV GoogLeNet; aux heads omitted —
+    the reference disables them at inference and modern training drops
+    them)."""
+
+    CFG = [  # (c1, c3r, c3, c5r, c5, cp)
+        (64, 96, 128, 16, 32, 32),      # 3a
+        (128, 128, 192, 32, 96, 64),    # 3b
+        (192, 96, 208, 16, 48, 64),     # 4a
+        (160, 112, 224, 24, 64, 64),    # 4b
+        (128, 128, 256, 24, 64, 64),    # 4c
+        (112, 144, 288, 32, 64, 64),    # 4d
+        (256, 160, 320, 32, 128, 128),  # 4e
+        (256, 160, 320, 32, 128, 128),  # 5a
+        (384, 192, 384, 48, 128, 128),  # 5b
+    ]
+    POOL_AFTER = {1, 6}                 # maxpool after 3b and 4e
+
+    def __init__(self, num_classes=1000, in_ch=3):
+        super().__init__()
+        self.stem1 = ConvBNLayer(in_ch, 64, 7, stride=2, act="relu")
+        self.stem2 = ConvBNLayer(64, 64, 1, act="relu")
+        self.stem3 = ConvBNLayer(64, 192, 3, act="relu")
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        blocks = []
+        ch = 192
+        for cfg in self.CFG:
+            blk = _Inception(ch, *cfg)
+            blocks.append(blk)
+            ch = blk.out_ch
+        self.blocks = LayerList(blocks)
+        self.fc = Linear(ch, num_classes, sharding=None)
+
+    def forward(self, params, x, *, training=False, key=None):
+        x = self.stem1(params["stem1"], x, training=training)
+        x = self.pool(None, x)
+        x = self.stem2(params["stem2"], x, training=training)
+        x = self.stem3(params["stem3"], x, training=training)
+        x = self.pool(None, x)
+        for i, blk in enumerate(self.blocks):
+            x = blk(params["blocks"][str(i)], x, training=training)
+            if i in self.POOL_AFTER:
+                x = self.pool(None, x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = F.dropout(x, key, rate=0.4,
+                      training=training and key is not None)
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training, key=key),
+            label)
+
+
+def channel_shuffle(x, groups):
+    """(B, H, W, C) channel shuffle (shuffle_channel_op): interleave
+    group channels."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    return x.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
+
+
+class _ShuffleUnit(Layer):
+    """ShuffleNetV2 unit: split-transform-concat-shuffle (stride 1) or
+    dual-branch downsample (stride 2)."""
+
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        half = out_ch // 2
+        branch_in = in_ch if stride == 2 else in_ch // 2
+        self.r1 = ConvBNLayer(branch_in, half, 1, act="relu")
+        self.rd = ConvBNLayer(half, half, 3, stride=stride,
+                              groups=half)            # depthwise
+        self.r2 = ConvBNLayer(half, half, 1, act="relu")
+        if stride == 2:
+            self.ld = ConvBNLayer(branch_in, branch_in, 3, stride=2,
+                                  groups=branch_in)
+            self.l1 = ConvBNLayer(branch_in, half, 1, act="relu")
+
+    def forward(self, params, x, training=False):
+        if self.stride == 1:
+            left, right = jnp.split(x, 2, axis=-1)
+        else:
+            left = right = x
+            left = self.l1(params["l1"],
+                           self.ld(params["ld"], left,
+                                   training=training),
+                           training=training)
+        right = self.r1(params["r1"], right, training=training)
+        right = self.rd(params["rd"], right, training=training)
+        right = self.r2(params["r2"], right, training=training)
+        return channel_shuffle(
+            jnp.concatenate([left, right], axis=-1), 2)
+
+
+class ShuffleNetV2(Layer):
+    """ShuffleNetV2 1.0x (PaddleCV ShuffleNetV2; stage channels for the
+    1.0x width)."""
+
+    STAGES = [(4, 116), (8, 232), (4, 464)]
+
+    def __init__(self, num_classes=1000, in_ch=3):
+        super().__init__()
+        self.stem = ConvBNLayer(in_ch, 24, 3, stride=2, act="relu")
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        units = []
+        ch = 24
+        for reps, out in self.STAGES:
+            units.append(_ShuffleUnit(ch, out, stride=2))
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(out, out, stride=1))
+            ch = out
+        self.units = LayerList(units)
+        self.tail = ConvBNLayer(ch, 1024, 1, act="relu")
+        self.fc = Linear(1024, num_classes, sharding=None)
+
+    def forward(self, params, x, *, training=False, key=None):
+        x = self.stem(params["stem"], x, training=training)
+        x = self.pool(None, x)
+        for i, u in enumerate(self.units):
+            x = u(params["units"][str(i)], x, training=training)
+        x = self.tail(params["tail"], x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        from paddle_tpu.models.common import classification_loss
+        return classification_loss(
+            self.forward(params, image, training=training, key=key),
+            label)

@@ -236,3 +236,65 @@ class TestVideoModels:
         assert model(params, batch["video"]).shape == (2, 4)
         _train_smoke(model, batch, steps=6,
                      optimizer=opt.Adam(learning_rate=1e-3))
+
+
+class TestLegacyCVZoo:
+    """AlexNet / GoogLeNet / ShuffleNetV2 — the classic PaddleCV
+    image_classification tail."""
+
+    def _train_steps(self, model, hw, n=8):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        rng = np.random.RandomState(0)
+        batch = dict(
+            image=jnp.asarray(rng.randn(4, hw, hw, 3), jnp.float32),
+            label=jnp.asarray(rng.randint(0, 5, (4,))))
+        # SGD avoids Adam's zero-second-moment overshoot on the huge
+        # AlexNet fc layers at step 1
+        optimizer = opt.Momentum(learning_rate=2e-3, momentum=0.9)
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+        def loss_fn(params, image, label, key):
+            # the REAL training path: dropout live, BN batch stats
+            return model.loss(params, image, label, training=True,
+                              key=key)
+
+        step = jax.jit(build_train_step(loss_fn, optimizer))
+        losses = []
+        for i in range(n):
+            state, m = step(state, image=batch["image"],
+                            label=batch["label"],
+                            key=jax.random.PRNGKey(100 + i))
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all(), losses
+        assert losses[-1] < losses[0], losses
+        # default call path (training=True, no key): dropout skipped,
+        # must not crash
+        l, _ = model.loss(state["params"], batch["image"], batch["label"])
+        assert np.isfinite(float(l))
+
+    def test_alexnet_trains(self):
+        from paddle_tpu.models.legacy_cv import AlexNet
+        self._train_steps(AlexNet(num_classes=5), hw=64)
+
+    def test_googlenet_trains(self):
+        from paddle_tpu.models.legacy_cv import GoogLeNet
+        self._train_steps(GoogLeNet(num_classes=5), hw=64)
+
+    def test_shufflenet_trains_and_shuffle_op(self):
+        from paddle_tpu.models.legacy_cv import (ShuffleNetV2,
+                                                 channel_shuffle)
+        x = jnp.arange(8.0).reshape(1, 1, 1, 8)
+        got = np.asarray(channel_shuffle(x, 2))[0, 0, 0]
+        np.testing.assert_array_equal(got, [0, 4, 1, 5, 2, 6, 3, 7])
+        self._train_steps(ShuffleNetV2(num_classes=5), hw=64)
+
+    def test_alexnet_dropout_path(self):
+        from paddle_tpu.models.legacy_cv import AlexNet
+        m = AlexNet(num_classes=5)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+        l1 = m.forward(p, x, training=True, key=jax.random.PRNGKey(1))
+        l2 = m.forward(p, x, training=True, key=jax.random.PRNGKey(2))
+        assert not np.allclose(np.asarray(l1), np.asarray(l2))
