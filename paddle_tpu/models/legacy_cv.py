@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.nn.layers import Conv2D, Linear, Pool2D
 from paddle_tpu.nn.module import Layer, LayerList
+from paddle_tpu.models.common import classification_loss
 from paddle_tpu.models.resnet import ConvBNLayer
 from paddle_tpu.ops import nn as F
 
@@ -60,7 +61,6 @@ class AlexNet(Layer):
         return self.fc3(params["fc3"], x)
 
     def loss(self, params, image, label, *, training=True, key=None):
-        from paddle_tpu.models.common import classification_loss
         return classification_loss(
             self.forward(params, image, training=training, key=key),
             label)
@@ -142,7 +142,6 @@ class GoogLeNet(Layer):
         return self.fc(params["fc"], x)
 
     def loss(self, params, image, label, *, training=True, key=None):
-        from paddle_tpu.models.common import classification_loss
         return classification_loss(
             self.forward(params, image, training=training, key=key),
             label)
@@ -221,7 +220,71 @@ class ShuffleNetV2(Layer):
         return self.fc(params["fc"], x)
 
     def loss(self, params, image, label, *, training=True, key=None):
-        from paddle_tpu.models.common import classification_loss
         return classification_loss(
             self.forward(params, image, training=training, key=key),
             label)
+
+
+class _DarkResidual(Layer):
+    """DarkNet53 residual: 1x1 squeeze + 3x3 expand, additive skip."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.c1 = ConvBNLayer(ch, ch // 2, 1, act="leaky")
+        self.c2 = ConvBNLayer(ch // 2, ch, 3, act="leaky")
+
+    def forward(self, params, x, training=False):
+        h = self.c1(params["c1"], x, training=training)
+        h = self.c2(params["c2"], h, training=training)
+        return x + h
+
+
+class DarkNet53(Layer):
+    """DarkNet53 (the reference YOLOv3 backbone — PaddleCV/PaddleDetection
+    darknet.py): conv-bn-leaky trunk with (1, 2, 8, 8, 4) residual
+    stages. Exposes the same ``features(...endpoints=)`` /
+    ``block_channels`` contract as MobileNetV1 so detectors swap
+    backbones freely. Stride-8/16/32 endpoints sit at block indices
+    13 / 22 / 27 (= -1)."""
+
+    STAGE_REPS = (1, 2, 8, 8, 4)
+
+    def __init__(self, num_classes=1000, in_ch=3, scale=1.0):
+        super().__init__()
+
+        def c(n):
+            return max(8, int(n * scale))
+
+        self.stem = ConvBNLayer(in_ch, c(32), 3, act="leaky")
+        blocks, widths = [], []
+        ch = c(32)
+        for i, reps in enumerate(self.STAGE_REPS):
+            out = c(64 * (2 ** i))
+            blocks.append(ConvBNLayer(ch, out, 3, stride=2, act="leaky"))
+            widths.append(out)
+            for _ in range(reps):
+                blocks.append(_DarkResidual(out))
+                widths.append(out)
+            ch = out
+        self.blocks = LayerList(blocks)
+        self.block_channels = widths
+        self.fc = Linear(ch, num_classes, sharding=None)
+
+    def features(self, params, x, training=False, *, endpoints=()):
+        """Forward through the trunk; returns (final, {idx: feat})."""
+        x = self.stem(params["stem"], x, training=training)
+        feats = {}
+        for i, block in enumerate(self.blocks):
+            x = block(params["blocks"][str(i)], x, training=training)
+            if i in endpoints:
+                feats[i] = x
+        return x, feats
+
+    def forward(self, params, x, *, training=False, key=None):
+        x, _ = self.features(params, x, training=training)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        return classification_loss(
+            self.forward(params, image, training=training), label)

@@ -34,6 +34,8 @@ class ConvBNLayer(Layer):
             x = jax.nn.relu(x)
         elif self.act == "relu6":
             x = jnp.clip(x, 0.0, 6.0)
+        elif self.act == "leaky":
+            x = jax.nn.leaky_relu(x, 0.1)    # darknet convention
         return x
 
 

@@ -8,7 +8,7 @@ yolo_box_op) over a DarkNet body."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +33,12 @@ class YOLOv3Config:
         (116, 90), (156, 198), (373, 326))
     anchor_masks: Tuple[Tuple[int, ...], ...] = ((6, 7, 8), (3, 4, 5),
                                                 (0, 1, 2))
-    # backbone endpoints for strides (32, 16, 8)
-    endpoints: Tuple[int, ...] = (-1, 10, 4)
+    # "mobilenet" (v1, lightweight PaddleDetection variant) or
+    # "darknet53" (the canonical reference backbone)
+    backbone: str = "mobilenet"
+    # backbone endpoints for strides (32, 16, 8); None = per-backbone
+    # default (mobilenet (-1, 10, 4); darknet53 (-1, 22, 13))
+    endpoints: Optional[Tuple[int, ...]] = None
     ignore_thresh: float = 0.7
 
     @classmethod
@@ -53,11 +57,22 @@ class YOLOv3(Layer):
     def __init__(self, cfg: YOLOv3Config):
         super().__init__()
         self.cfg = cfg
-        self.backbone = MobileNetV1(num_classes=1,
-                                    scale=cfg.backbone_scale)
+        if cfg.backbone == "darknet53":
+            from paddle_tpu.models.legacy_cv import DarkNet53
+            self.backbone = DarkNet53(num_classes=1,
+                                      scale=cfg.backbone_scale)
+            endpoints = (cfg.endpoints if cfg.endpoints is not None
+                         else (-1, 22, 13))
+        elif cfg.backbone == "mobilenet":
+            self.backbone = MobileNetV1(num_classes=1,
+                                        scale=cfg.backbone_scale)
+            endpoints = (cfg.endpoints if cfg.endpoints is not None
+                         else (-1, 10, 4))
+        else:
+            raise ValueError(f"unknown backbone {cfg.backbone!r}")
         n_blocks = len(self.backbone.blocks)
         self._endpoints = tuple(i if i >= 0 else n_blocks - 1
-                                for i in cfg.endpoints)
+                                for i in endpoints)
 
         widths = self.backbone.block_channels
         heads, necks = [], []

@@ -241,3 +241,49 @@ class TestMaskRCNN:
         assert set(np.unique(m_np)) <= {0.0, 1.0}
         if (~ok_np).any():
             assert m_np[~ok_np].sum() == 0.0
+
+
+class TestDarkNetYOLO:
+    def test_darknet53_features_strides(self):
+        from paddle_tpu.models.legacy_cv import DarkNet53
+        m = DarkNet53(num_classes=3, scale=0.25)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((1, 64, 64, 3))
+        out, feats = m.features(p, x, endpoints=(13, 22, 27))
+        assert feats[13].shape[1] == 8     # stride 8
+        assert feats[22].shape[1] == 4     # stride 16
+        assert feats[27].shape[1] == 2     # stride 32
+        logits = m.forward(p, x)
+        assert logits.shape == (1, 3)
+
+    def test_yolov3_darknet_backbone_trains(self):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.models.yolov3 import YOLOv3, YOLOv3Config
+        from paddle_tpu.train import build_train_step, make_train_state
+
+        cfg = YOLOv3Config(
+            num_classes=4, image_size=64, backbone="darknet53",
+            backbone_scale=0.125,
+            anchors=((8, 8), (16, 16), (32, 32), (48, 48)),
+            anchor_masks=((2, 3), (0, 1)),
+            endpoints=(-1, 22))
+        model = YOLOv3(cfg)
+        rng = np.random.RandomState(0)
+        ctr = rng.rand(2, 2, 2) * 0.5 + 0.25
+        wh = rng.rand(2, 2, 2) * 0.3 + 0.2
+        batch = dict(
+            image=jnp.asarray(rng.randn(2, 64, 64, 3).astype(np.float32)),
+            gt_boxes=jnp.asarray(
+                np.concatenate([ctr, wh], -1).astype(np.float32)),
+            gt_labels=jnp.asarray(rng.randint(0, 4, (2, 2))),
+            gt_mask=jnp.ones((2, 2), bool))
+        optimizer = opt.Adam(learning_rate=1e-3)
+        step = jax.jit(build_train_step(
+            lambda p, **b: model.loss(p, **b), optimizer))
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(5):
+            state, m = step(state, **batch)
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all(), losses
+        assert losses[-1] < losses[0], losses
