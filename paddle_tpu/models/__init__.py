@@ -18,7 +18,8 @@ from paddle_tpu.models.ssd import SSD, SSDConfig
 from paddle_tpu.models.faster_rcnn import (FasterRCNN, FasterRCNNConfig,
                                             MaskRCNN)
 from paddle_tpu.models.legacy_cv import (AlexNet, DarkNet53,
-                                         GoogLeNet, ShuffleNetV2)
+                                         DenseNet121, GoogLeNet,
+                                         ShuffleNetV2, SqueezeNet)
 from paddle_tpu.models.video import C3D, TSN
 from paddle_tpu.models.yolov3 import YOLOv3, YOLOv3Config
 from paddle_tpu.models.ocr import CRNN
@@ -30,4 +31,4 @@ __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
            "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
            "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
-           "SEResNeXt50", "AlexNet", "DarkNet53", "GoogLeNet", "ShuffleNetV2", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "MaskRCNN", "C3D", "TSN", "YOLOv3", "YOLOv3Config", "CRNN", "DCGANGenerator", "DCGANDiscriminator", "gan_step"]
+           "SEResNeXt50", "AlexNet", "DarkNet53", "DenseNet121", "GoogLeNet", "ShuffleNetV2", "SqueezeNet", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "MaskRCNN", "C3D", "TSN", "YOLOv3", "YOLOv3Config", "CRNN", "DCGANGenerator", "DCGANDiscriminator", "gan_step"]

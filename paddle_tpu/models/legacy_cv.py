@@ -288,3 +288,131 @@ class DarkNet53(Layer):
     def loss(self, params, image, label, *, training=True, key=None):
         return classification_loss(
             self.forward(params, image, training=training), label)
+
+
+class _Fire(Layer):
+    """SqueezeNet fire module: 1x1 squeeze -> parallel 1x1 + 3x3 expand."""
+
+    def __init__(self, in_ch, s1, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(in_ch, s1, 1)
+        self.e1 = Conv2D(s1, e1, 1)
+        self.e3 = Conv2D(s1, e3, 3, padding=1)
+        self.out_ch = e1 + e3
+
+    def forward(self, params, x, training=False):
+        s = jax.nn.relu(self.squeeze(params["squeeze"], x))
+        return jnp.concatenate(
+            [jax.nn.relu(self.e1(params["e1"], s)),
+             jax.nn.relu(self.e3(params["e3"], s))], axis=-1)
+
+
+class SqueezeNet(Layer):
+    """SqueezeNet 1.1 (PaddleCV SqueezeNet): conv stem + 8 fire modules
+    + per-class 1x1 conv head with global average pooling."""
+
+    CFG = [(16, 64, 64), (16, 64, 64), (32, 128, 128), (32, 128, 128),
+           (48, 192, 192), (48, 192, 192), (64, 256, 256),
+           (64, 256, 256)]
+    POOL_AFTER = {1, 3}      # maxpool after fire3/fire5 (1.1 layout;
+    #   list indices 1 and 3 — fires are named from fire2 in the paper)
+
+    def __init__(self, num_classes=1000, in_ch=3):
+        super().__init__()
+        self.stem = Conv2D(in_ch, 64, 3, stride=2, padding=1)
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        fires = []
+        ch = 64
+        for cfg in self.CFG:
+            f = _Fire(ch, *cfg)
+            fires.append(f)
+            ch = f.out_ch
+        self.fires = LayerList(fires)
+        self.head = Conv2D(ch, num_classes, 1)
+
+    def forward(self, params, x, *, training=False, key=None):
+        x = jax.nn.relu(self.stem(params["stem"], x))
+        x = self.pool(None, x)
+        for i, f in enumerate(self.fires):
+            x = f(params["fires"][str(i)], x, training=training)
+            if i in self.POOL_AFTER:
+                x = self.pool(None, x)
+        x = F.dropout(x, key, rate=0.5,
+                      training=training and key is not None)
+        x = jax.nn.relu(self.head(params["head"], x))
+        return jnp.mean(x, axis=(1, 2))          # (B, num_classes)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        return classification_loss(
+            self.forward(params, image, training=training, key=key),
+            label)
+
+
+class _DenseBlock(Layer):
+    """DenseNet block: L bottleneck (1x1 then 3x3 conv-bn-relu) layers,
+    each consuming the concat of all previous features. NOTE: uses this
+    codebase's post-activation ConvBNLayer idiom, not the paper's
+    pre-activation BN-ReLU-conv ordering — same connectivity, different
+    tensor layout for checkpoint porting."""
+
+    def __init__(self, in_ch, growth, reps):
+        super().__init__()
+        layers = []
+        ch = in_ch
+        for _ in range(reps):
+            layers.append(LayerList([
+                ConvBNLayer(ch, 4 * growth, 1, act="relu"),
+                ConvBNLayer(4 * growth, growth, 3, act="relu")]))
+            ch += growth
+        self.layers = LayerList(layers)
+        self.out_ch = ch
+
+    def forward(self, params, x, training=False):
+        for i, pair in enumerate(self.layers):
+            p = params["layers"][str(i)]
+            h = pair[0](p["0"], x, training=training)
+            h = pair[1](p["1"], h, training=training)
+            x = jnp.concatenate([x, h], axis=-1)
+        return x
+
+
+class DenseNet121(Layer):
+    """DenseNet-121 (PaddleCV DenseNet; growth 32, blocks 6/12/24/16,
+    0.5x transition compression)."""
+
+    BLOCKS = (6, 12, 24, 16)
+
+    def __init__(self, num_classes=1000, in_ch=3, growth=32):
+        super().__init__()
+        self.stem = ConvBNLayer(in_ch, 2 * growth, 7, stride=2,
+                                act="relu")
+        self.pool = Pool2D(3, stride=2, padding=1, pool_type="max")
+        self.avg = Pool2D(2, stride=2, pool_type="avg")
+        blocks, trans = [], []
+        ch = 2 * growth
+        for i, reps in enumerate(self.BLOCKS):
+            blk = _DenseBlock(ch, growth, reps)
+            blocks.append(blk)
+            ch = blk.out_ch
+            if i < len(self.BLOCKS) - 1:
+                trans.append(ConvBNLayer(ch, ch // 2, 1, act="relu"))
+                ch //= 2
+        self.blocks = LayerList(blocks)
+        self.trans = LayerList(trans)
+        self.fc = Linear(ch, num_classes, sharding=None)
+
+    def forward(self, params, x, *, training=False, key=None):
+        x = self.stem(params["stem"], x, training=training)
+        x = self.pool(None, x)
+        for i, blk in enumerate(self.blocks):
+            x = blk(params["blocks"][str(i)], x, training=training)
+            if i < len(self.trans):
+                x = self.trans[i](params["trans"][str(i)], x,
+                                  training=training)
+                x = self.avg(None, x)
+        x = jnp.mean(x, axis=(1, 2))
+        return self.fc(params["fc"], x)
+
+    def loss(self, params, image, label, *, training=True, key=None):
+        return classification_loss(
+            self.forward(params, image, training=training), label)

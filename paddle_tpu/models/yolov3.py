@@ -1,5 +1,6 @@
 """YOLOv3 detector — PaddleCV yolov3 parity: multi-scale one-stage
-detection over a MobileNet backbone with per-scale anchor-masked heads,
+detection over a selectable MobileNetV1 or DarkNet53 backbone with
+per-scale anchor-masked heads,
 trained with ``ops.detection.yolov3_loss`` and decoded with ``yolo_box``
 (+ per-class NMS). The reference composes the same ops
 (fluid.layers.yolov3_loss / yolo_box, operators/detection/yolov3_loss_op,

@@ -298,3 +298,11 @@ class TestLegacyCVZoo:
         l1 = m.forward(p, x, training=True, key=jax.random.PRNGKey(1))
         l2 = m.forward(p, x, training=True, key=jax.random.PRNGKey(2))
         assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+    def test_squeezenet_trains(self):
+        from paddle_tpu.models.legacy_cv import SqueezeNet
+        self._train_steps(SqueezeNet(num_classes=5), hw=64)
+
+    def test_densenet_trains(self):
+        from paddle_tpu.models.legacy_cv import DenseNet121
+        self._train_steps(DenseNet121(num_classes=5, growth=8), hw=64)
