@@ -153,13 +153,16 @@ def _tree_patch_weights(edges, num_nodes, max_depth):
     """Host-side tree2col (math/tree2col.cc:82): DFS patch per root with
     continuous-binary-tree weights eta_t/l/r. Returns (P, N, 3) float32
     where row p holds node weights for root p+1 (1-based nodes)."""
+    # directed parent->child adjacency (Tree2ColUtil::construct_tree
+    # inserts only the (parent, child) edge), so the DFS from each root
+    # visits descendants only and pclen is the parent's child count
     tr = [[] for _ in range(num_nodes + 1)]
     for a, b in np.asarray(edges).reshape(-1, 2):
         a, b = int(a), int(b)
-        if a == 0 and b == 0:
-            continue  # padded edge rows
+        if a == 0 or b == 0:
+            continue  # padded edge rows (construct_tree stops at any
+            # zero endpoint — node ids are 1-based)
         tr[a].append(b)
-        tr[b].append(a)
 
     weights = np.zeros((num_nodes, num_nodes, 3), np.float32)
 
@@ -285,8 +288,11 @@ def sample_logits(logits, labels, num_samples, rng=None, *,
 
     Negatives follow the log-uniform class distribution
     Q(c) = log((c+2)/(c+1)) / log(range+1) (math/sampler.cc:56), drawn
-    with replacement and shared across the batch like the reference's
-    sampler; Q is scaled by num_samples (the reference's
+    with replacement and SHARED across the batch exactly like the
+    reference: SampleWithProb's sampling loop writes each drawn v into
+    every row (sample_prob.h:78-92), and the CUDA kernel copies row 0's
+    columns to all rows (sample_prob.cu:86). Q is scaled by num_samples
+    (the reference's
     num_tries==num_samples branch of adjust_prob, sample_prob.h:30 —
     its uniquifying retry loop is host-side control flow; here the
     with-replacement closed form keeps the op jittable). Pass
@@ -316,7 +322,8 @@ def sample_logits(logits, labels, num_samples, rng=None, *,
             raise ValueError("sample_logits needs a PRNG key when not "
                              "given customized_samples")
         u = jax.random.uniform(rng, (num_samples,), logits.dtype)
-        # inverse-transform log-uniform (sampler.cc:44)
+        # inverse-transform log-uniform (sampler.cc:44); one shared draw
+        # broadcast to every row, matching sample_prob.h:78-92
         neg = (jnp.exp(u * log_range) - 1.0).astype(jnp.int32) % num_classes
         samples = jnp.concatenate(
             [labels, jnp.broadcast_to(neg[None, :], (b, num_samples))], 1)

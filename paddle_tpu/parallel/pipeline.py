@@ -86,14 +86,18 @@ def gpipe(
     ``block_fn(layer_params, h, extra, mb_idx) -> h``; ``stacked_params``
     leaves are (L_total, ...) with L_total divisible by the "pp" axis size;
     ``x_microbatches``: (M, mb, ...) microbatched activations; ``extras``:
-    optional pytree of (M, ...) per-microbatch side inputs that travel the
-    ring with the activation (e.g. attention bias); ``mb_idx`` is the
+    optional pytree of (M, ...) per-microbatch side inputs (e.g. attention
+    bias). Extras must be REPLICATED over the "pp" axis (as
+    :func:`microbatch_extras` produces): only the scalar microbatch index
+    rides the ring, and every stage indexes its local extras copy by it —
+    an extras leaf sharded over "pp" would be silently mis-indexed, so
+    ``extras_spec`` mentioning the pp axis is rejected. ``mb_idx`` is the
     traced int32 microbatch index (for dropout key folding).
 
     ``x_spec``/``extras_spec``: PartitionSpecs for the (M, ...) arrays so
-    batch/sequence sharding over the other mesh axes is preserved inside
-    the pipeline (default: replicated). Returns (M, mb, ...) outputs
-    (replicated over "pp", sharded per ``x_spec`` elsewhere).
+    batch/sequence sharding over the other (non-pp) mesh axes is preserved
+    inside the pipeline (default: replicated). Returns (M, mb, ...)
+    outputs (replicated over "pp", sharded per ``x_spec`` elsewhere).
     """
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None:
@@ -105,6 +109,8 @@ def gpipe(
     x_spec = x_spec if x_spec is not None else P()
     if extras_spec is None:
         extras_spec = jax.tree_util.tree_map(lambda _: P(), extras)
+    _check_pp_replicated(x_spec, axis, "x_spec")
+    _check_pp_replicated(extras_spec, axis, "extras_spec")
 
     def local_stage(local_params, h, extra, mb):
         # apply this stage's L_total/n layers (scan over stacked leaves)
@@ -160,6 +166,25 @@ def gpipe(
         out_specs=x_spec,
         check_vma=False,
     )(stacked_params, x_microbatches, extras)
+
+
+def _check_pp_replicated(spec_tree, axis, what):
+    """Activations and extras are indexed locally by the riding
+    microbatch index, which requires every leaf to be replicated over
+    the pp axis — a pp-sharded leaf would shrink the local microbatch
+    dimension and be silently mis-indexed (clamped), so reject it."""
+    for spec in jax.tree_util.tree_leaves(
+            spec_tree, is_leaf=lambda s: isinstance(s, P)):
+        if not isinstance(spec, P):
+            continue
+        for entry in spec:
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis in names:
+                raise ValueError(
+                    f"{what} {spec} shards over the pipeline axis "
+                    f"{axis!r}; {what} must be pp-replicated (see "
+                    f"microbatch_extras) because stages index the "
+                    f"(M, ...) arrays locally by microbatch index")
 
 
 def _masked_row_update(buf, idx, row, pred):
@@ -264,6 +289,8 @@ def circular_pipeline(
     x_spec = x_spec if x_spec is not None else P()
     if extras_spec is None:
         extras_spec = jax.tree_util.tree_map(lambda _: P(), extras)
+    _check_pp_replicated(x_spec, axis, "x_spec")
+    _check_pp_replicated(extras_spec, axis, "extras_spec")
 
     # contiguous P(axis) sharding must hand device s its v interleaved
     # chunks in circuit order; pre-arrange at layout time when possible

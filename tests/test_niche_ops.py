@@ -157,6 +157,21 @@ class TestTreeConv:
                                       jnp.asarray(filt), max_depth=2))
         # root1: eta_t(d0)=1 on node1, eta_t(d1)=0.5 on node2 -> 1 + 5
         np.testing.assert_allclose(out[0, 0, 0, 0], 6.0, rtol=1e-6)
+        # root2 is a leaf: edges are directed parent->child
+        # (tree2col.cc construct_tree), so its patch is just itself —
+        # 10 * eta_t(d0)=1 -> 10.0, NOT 10.5 (climbing to node 1 would
+        # add 1 * 0.5 from the undirected traversal)
+        np.testing.assert_allclose(out[0, 1, 0, 0], 10.0, rtol=1e-6)
+
+    def test_leaf_rooted_patch_only_contains_leaf(self):
+        # chain 1->2->3: patch(3) must be {3} alone even at max_depth=3
+        edges = np.asarray([[1, 2], [2, 3]], np.int32)
+        ws = NI._tree_patch_weights(edges, 3, 3)
+        assert ws[2, 0].sum() == 0 and ws[2, 1].sum() == 0
+        assert ws[2, 2].sum() > 0
+        # and node 2's patch is {2, 3} (its descendant), never node 1
+        assert ws[1, 0].sum() == 0
+        assert ws[1, 1].sum() > 0 and ws[1, 2].sum() > 0
 
     def test_grad_wrt_features_and_filter(self):
         edges = np.asarray([[[1, 2], [2, 3]]], np.int32)
@@ -255,12 +270,14 @@ class TestSampleLogits:
         s = np.asarray(s)
         assert s.shape == (4, 17)
         assert (s >= 0).all() and (s < 50).all()
-        # negatives shared across batch (reference samples once per batch)
+        # negatives shared across batch — SampleWithProb writes each drawn
+        # v into every row (sample_prob.h:78-92) and the CUDA kernel
+        # copies row 0's columns to all rows (sample_prob.cu:86)
         assert (s[:, 1:] == s[0, 1:]).all()
-        # Q matches the log-uniform closed form * num_samples
-        v = s[0].astype(np.float64)
+        # Q matches the log-uniform closed form * num_samples, every row
+        v = s.astype(np.float64)
         q = np.log((v + 2) / (v + 1)) / np.log(51.0) * 16
-        np.testing.assert_allclose(np.asarray(p)[0], q, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(p), q, rtol=1e-5)
 
     def test_log_uniform_skew(self):
         # log-uniform sampling strongly favors small class ids

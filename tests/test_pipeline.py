@@ -96,6 +96,19 @@ class TestGPipe:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    def test_pp_sharded_extras_rejected(self, pp_mesh):
+        """Extras are indexed locally and must be pp-replicated; a spec
+        sharding them over the pp axis is a contract violation."""
+        layers = _make_layers(jax.random.PRNGKey(7), 4, 8)
+        stacked = stack_layer_params(layers)
+        x = jnp.zeros((4, 2, 8))
+        extra = jnp.zeros((4, 2, 8))
+        from jax.sharding import PartitionSpec as P
+        with mesh_context(pp_mesh):
+            with pytest.raises(ValueError, match="pp-replicated"):
+                gpipe(_block, stacked, x, extras=extra,
+                      extras_spec=P("pp"), mesh=pp_mesh)
+
     def test_mb_idx_tracks_microbatch(self, pp_mesh):
         """The microbatch index delivered to the block must equal the true
         index of the microbatch being computed (dropout-PRNG contract)."""

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Poll the axon TPU tunnel out-of-process; fire tpu_session.sh on first success.
+# Exits 0 after a session run (success or not), exits 3 if the tunnel never
+# came up within MAX_WAIT seconds.
+cd "$(dirname "$0")/.."
+LOG=tools/tpu_logs/watch.log
+mkdir -p tools/tpu_logs
+MAX_WAIT=${MAX_WAIT:-36000}
+INTERVAL=${INTERVAL:-240}
+start=$(date +%s)
+while :; do
+  now=$(date +%s)
+  elapsed=$((now - start))
+  if [ "$elapsed" -gt "$MAX_WAIT" ]; then
+    echo "$(date -u +%FT%TZ) giving up after ${elapsed}s" >> "$LOG"
+    exit 3
+  fi
+  # out-of-process probe with hard timeout; jax.devices() hangs when tunnel is down
+  if timeout 150 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d; print(d)" \
+      >> "$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) TPU UP after ${elapsed}s - firing session" >> "$LOG"
+    sh tools/tpu_session.sh >> tools/tpu_logs/session.log 2>&1
+    echo "$(date -u +%FT%TZ) session finished rc=$?" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZZ) probe failed at ${elapsed}s; sleeping $INTERVAL" >> "$LOG"
+  sleep "$INTERVAL"
+done
