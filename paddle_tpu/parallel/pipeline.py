@@ -267,6 +267,16 @@ def circular_pipeline(
     loop reverses the same schedule, and only ~n chunk activations are
     live per tick (1F1B's memory profile) instead of GPipe's M.
 
+    Wall-clock caveat (measured, tools/PIPELINE_TIMING.md): the
+    structural win only converts to step time when per-tick fixed
+    overhead (ring ppermute + banking) is small against per-chunk
+    compute — per-tick cost is ``a + (L/(n*v))*c``, and circular runs
+    more ticks. On the 8-device CPU mesh (a/c ~ 0.3) circular only
+    reaches parity at dim>=1024, mb>=32, pp=4; on TPU the ICI hop makes
+    a/c orders smaller, but that number is still hardware-gated. GPipe
+    is the default schedule; circular is opt-in for long microbatch
+    streams on real interconnects.
+
     Same contract as :func:`gpipe` otherwise; ``stacked_params`` leaves
     are (L, ...) with L divisible by n * num_circuits.
     """
