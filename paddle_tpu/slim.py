@@ -198,13 +198,28 @@ def _is_qleaf(node) -> bool:
     return isinstance(node, dict) and set(node) == {"q", "scale", "axis"}
 
 
-def dequantize_weights(qparams, dtype=jnp.float32):
+def dequantize_weights(qparams, dtype=jnp.float32, *,
+                       keep_int8_resident: bool = False):
     """Inverse of :func:`quantize_weights_int8`: rebuild a dense param
-    pytree in ``dtype`` (serve-time load path)."""
+    pytree in ``dtype`` (serve-time load path).
+
+    ``keep_int8_resident``: wrap each int8 leaf in
+    ``lax.optimization_barrier`` before the in-graph dequant. Without
+    it, when the int8 weights are BAKED AS CONSTANTS (the frozen native
+    serving artifact), XLA constant-folds q*scale into a full-width
+    float constant at compile time — silently quadrupling the
+    executable's weight memory and voiding the int8 residency claim
+    (verified on the CPU backend: the s8 constant disappears from the
+    optimized HLO without the barrier). Weights passed as *arguments*
+    (the Predictor path) stay int8 either way — arguments cannot be
+    folded."""
 
     def walk(node):
         if _is_qleaf(node):
-            return (node["q"].astype(jnp.float32)
+            q = node["q"]
+            if keep_int8_resident:
+                q = jax.lax.optimization_barrier(q)
+            return (q.astype(jnp.float32)
                     * node["scale"]).astype(dtype)
         if isinstance(node, dict):
             return {k: walk(v) for k, v in node.items()}

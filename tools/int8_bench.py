@@ -1,0 +1,86 @@
+"""int8-vs-float serving comparison on the current backend.
+
+Measures, for a matmul-heavy serving graph (the int8 win case):
+  - compiled artifact s8-buffer survival (the residency proof)
+  - serve latency (median of N runs)
+  - executable/device memory via memory_analysis()
+Prints ONE JSON line; run inside the TPU session for the hardware
+numbers (CPU run is labeled honestly).
+"""
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=50)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import slim
+
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+    params = {f"l{i}": {"w": rng.randn(args.dim, args.dim)
+                        .astype(np.float32) * 0.03}
+              for i in range(args.layers)}
+
+    def net(p, x):
+        for i in range(args.layers):
+            x = jnp.tanh(x @ p[f"l{i}"]["w"])
+        return x
+
+    x = jnp.asarray(rng.randn(args.batch, args.dim), jnp.float32)
+    q = slim.quantize_weights_int8(params)
+
+    def f_float(x):
+        return net(params, x)
+
+    def f_int8(x):
+        return net(slim.dequantize_weights(q, keep_int8_resident=True), x)
+
+    out = {"device": str(dev), "dim": args.dim, "layers": args.layers,
+           "batch": args.batch}
+    results = {}
+    for name, fn in (("float32", f_float), ("int8", f_int8)):
+        c = jax.jit(fn).lower(x).compile()
+        hlo = c.as_text()
+        mem = c.memory_analysis()
+        r = c(x)
+        jax.block_until_ready(r)
+        results[name] = r
+        ts = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(c(x))
+            ts.append(time.perf_counter() - t0)
+        out[name] = {
+            "latency_ms": statistics.median(ts) * 1e3,
+            "s8_weight_bufs": hlo.count(f"s8[{args.dim},{args.dim}]") > 0,
+            "generated_code_bytes": getattr(
+                mem, "generated_code_size_in_bytes", None),
+            "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
+            "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
+        }
+    fl = out["float32"]["latency_ms"]
+    i8 = out["int8"]["latency_ms"]
+    out["int8_vs_float_latency"] = i8 / fl
+    # numerical sanity: int8 path tracks float within quantization error
+    # (reuse the executables' outputs — no recompilation)
+    d = float(jnp.max(jnp.abs(jnp.asarray(results["float32"][0]) -
+                              jnp.asarray(results["int8"][0]))))
+    out["max_abs_diff"] = d
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

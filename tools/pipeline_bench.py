@@ -98,7 +98,7 @@ def main():
             v = args.circuits if schedule == "circular" else 1
             rec = dict(
                 pp=pp, schedule=schedule, step_ms=med * 1e3,
-                p10_ms=sorted(times)[len(times) // 10] * 1e3,
+                min_ms=min(times) * 1e3,
                 compile_s=compile_s,
                 bubble_analytic=pl.pipeline_bubble_fraction(
                     pp, args.M, v),

@@ -5,6 +5,7 @@
 #   BENCH_RESNET.json         ResNet-50 (target vs_baseline >= 1.0)
 #   BENCH_TRANSFORMER.json    Transformer-big packed varlen (config 4)
 #   BENCH_DEEPFM.json         DeepFM host-KV CTR (config 5)
+#   INT8_TPU.json             int8-vs-float serve latency/memory + s8 proof
 #   NATIVE_E2E.txt            the PJRT C++ runner end-to-end parity proof
 # Safe to re-run: a failed step never clobbers a previously good artifact.
 set -x
@@ -46,6 +47,9 @@ run transformer timeout 1800 python bench.py --model transformer \
 run deepfm      timeout 1800 python bench.py --model deepfm \
   && keep tools/tpu_logs/deepfm.out BENCH_DEEPFM.json
 
+run int8        timeout 900 python tools/int8_bench.py \
+  && keep tools/tpu_logs/int8.out INT8_TPU.json
+
 # the hardware-gated native-runner parity test (must NOT skip on TPU)
 if run native_e2e timeout 900 python -m pytest \
     tests/test_native_inference.py::TestNativeExecution -q -rs; then
@@ -53,4 +57,4 @@ if run native_e2e timeout 900 python -m pytest \
 fi
 
 echo "session done; artifacts: BENCH_r04.json BENCH_RESNET.json \
-BENCH_TRANSFORMER.json BENCH_DEEPFM.json NATIVE_E2E.txt"
+BENCH_TRANSFORMER.json BENCH_DEEPFM.json INT8_TPU.json NATIVE_E2E.txt"
