@@ -1,6 +1,10 @@
 """Module/Layer system + standard layers."""
 
 from paddle_tpu.nn import initializer
+from paddle_tpu.nn import distributions
+from paddle_tpu.nn.distributions import (Categorical, Distribution,
+                                         MultivariateNormalDiag, Normal,
+                                         Uniform)
 from paddle_tpu.nn.module import (Layer, LayerList, ParamSpec, Sequential,
                                   apply_state_updates, capture_state,
                                   report_state)
@@ -14,7 +18,9 @@ from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, LSTMPCell,
                                RNN, SimpleRNNCell)
 
 __all__ = [
-    "initializer", "Layer", "LayerList", "ParamSpec", "Sequential",
+    "initializer", "distributions", "Categorical", "Distribution",
+    "MultivariateNormalDiag", "Normal", "Uniform",
+    "Layer", "LayerList", "ParamSpec", "Sequential",
     "apply_state_updates", "capture_state", "report_state",
     "FC", "BatchNorm", "Conv2D", "Dropout", "Embedding", "LayerNorm",
     "Linear", "Pool2D",

@@ -1,0 +1,230 @@
+"""Distributions parity tests vs scipy closed forms.
+
+Reference behaviors under test: ``fluid.layers.distributions``
+(``distributions.py:113`` Uniform, ``:246`` Normal, ``:401`` Categorical,
+``:494`` MultivariateNormalDiag), checked against ``scipy.stats`` instead of
+the reference's hand-written numpy oracles (``test_distributions.py`` in the
+reference unittests does the same comparison-to-closed-form exercise).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats as st
+
+from paddle_tpu.nn.distributions import (Categorical, MultivariateNormalDiag,
+                                         Normal, Uniform, kl_divergence)
+
+
+class TestUniform:
+    def test_log_prob_matches_scipy(self):
+        low, high = np.array([0.0, 1.0]), np.array([2.0, 5.0])
+        d = Uniform(low, high)
+        x = np.array([1.0, 2.5])
+        np.testing.assert_allclose(
+            d.log_prob(x), st.uniform(low, high - low).logpdf(x), rtol=1e-6)
+
+    def test_log_prob_outside_support(self):
+        d = Uniform(0.0, 1.0)
+        assert np.isneginf(d.log_prob(2.0))
+        assert np.isneginf(d.log_prob(-0.5))
+
+    def test_entropy_matches_scipy(self):
+        d = Uniform(np.array([0.0, -1.0]), np.array([4.0, 1.0]))
+        np.testing.assert_allclose(
+            d.entropy(), st.uniform([0.0, -1.0], [4.0, 2.0]).entropy(),
+            rtol=1e-6)
+
+    def test_sample_shape_and_range(self):
+        d = Uniform(np.array([0.0, 10.0]), np.array([1.0, 20.0]))
+        s = d.sample((1000,), key=jax.random.PRNGKey(0))
+        assert s.shape == (1000, 2)
+        assert (s[:, 0] >= 0).all() and (s[:, 0] < 1).all()
+        assert (s[:, 1] >= 10).all() and (s[:, 1] < 20).all()
+        # mean of U[10,20) ≈ 15
+        assert abs(float(s[:, 1].mean()) - 15.0) < 0.5
+
+    def test_kl_contained_and_not(self):
+        p, q = Uniform(0.0, 1.0), Uniform(-1.0, 3.0)
+        np.testing.assert_allclose(p.kl_divergence(q), np.log(4.0), rtol=1e-6)
+        assert np.isposinf(q.kl_divergence(p))
+
+    def test_broadcasting(self):
+        d = Uniform(0.0, np.array([1.0, 2.0, 4.0]))
+        assert d.entropy().shape == (3,)
+        assert d.sample((5,), key=jax.random.PRNGKey(1)).shape == (5, 3)
+
+
+class TestNormal:
+    def test_log_prob_matches_scipy(self):
+        loc, scale = np.array([0.0, 2.0]), np.array([1.0, 3.0])
+        d = Normal(loc, scale)
+        x = np.array([0.7, -1.2])
+        np.testing.assert_allclose(
+            d.log_prob(x), st.norm(loc, scale).logpdf(x), rtol=1e-5)
+
+    def test_entropy_matches_scipy(self):
+        loc, scale = np.array([0.0, 2.0]), np.array([1.0, 3.0])
+        np.testing.assert_allclose(
+            Normal(loc, scale).entropy(), st.norm(loc, scale).entropy(),
+            rtol=1e-6)
+
+    def test_kl_closed_form(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        # scipy has no normal-normal KL; closed form 0.5(σr+t1-1-lnσr)
+        var_ratio = (1.0 / 2.0) ** 2
+        expect = 0.5 * (var_ratio + (1.0 / 2.0) ** 2 - 1.0
+                        - np.log(var_ratio))
+        np.testing.assert_allclose(p.kl_divergence(q), expect, rtol=1e-6)
+        np.testing.assert_allclose(p.kl_divergence(Normal(0.0, 1.0)), 0.0,
+                                   atol=1e-7)
+
+    def test_sample_moments(self):
+        d = Normal(3.0, 0.5)
+        s = d.sample((20000,), key=jax.random.PRNGKey(0))
+        assert abs(float(s.mean()) - 3.0) < 0.02
+        assert abs(float(s.std()) - 0.5) < 0.02
+
+    def test_jit_and_grad(self):
+        def loss(loc):
+            return Normal(loc, 1.0).log_prob(0.0)
+        g = jax.jit(jax.grad(loss))(2.0)
+        np.testing.assert_allclose(g, -2.0, rtol=1e-6)  # d/dμ logN = (x-μ)/σ²
+
+
+class TestCategorical:
+    def test_entropy_matches_scipy(self):
+        logits = np.array([0.1, 1.2, -0.3, 0.0], np.float32)
+        d = Categorical(logits)
+        p = np.exp(logits) / np.exp(logits).sum()
+        np.testing.assert_allclose(d.entropy()[0], st.entropy(p), rtol=1e-5)
+
+    def test_reference_doc_example(self):
+        # reference docstring values (distributions.py:429-439)
+        a = Categorical(np.array([-0.602, -0.602], np.float32))
+        b = Categorical(np.array([-0.102, -0.112], np.float32))
+        np.testing.assert_allclose(a.entropy(), [0.6931472], rtol=1e-5)
+        np.testing.assert_allclose(b.entropy(), [0.6931347], rtol=1e-5)
+        np.testing.assert_allclose(a.kl_divergence(b), [1.2516975e-05],
+                                   atol=1e-8)
+
+    def test_kl_vs_scipy(self):
+        la = np.array([0.5, -0.5, 1.0], np.float32)
+        lb = np.array([0.0, 0.2, -0.1], np.float32)
+        pa = np.exp(la) / np.exp(la).sum()
+        pb = np.exp(lb) / np.exp(lb).sum()
+        np.testing.assert_allclose(
+            Categorical(la).kl_divergence(Categorical(lb))[0],
+            st.entropy(pa, pb), rtol=1e-5)
+
+    def test_log_prob_and_sample(self):
+        logits = np.array([[0.0, 1.0, 2.0], [2.0, 1.0, 0.0]], np.float32)
+        d = Categorical(logits)
+        lp = d.log_prob(np.array([2, 0]))
+        expect = jax.nn.log_softmax(logits)[np.arange(2), [2, 0]]
+        np.testing.assert_allclose(lp, expect, rtol=1e-6)
+        s = d.sample((500,), key=jax.random.PRNGKey(0))
+        assert s.shape == (500, 2)
+        # class 2 dominates row 0 (softmax([0,1,2])[2] ≈ .665)
+        frac = float((s[:, 0] == 2).mean())
+        assert 0.58 < frac < 0.74
+
+    def test_saturated_logits_stay_finite(self):
+        # a collapsed policy underflows suppressed classes to logp=-inf;
+        # entropy/KL must define p·log p = 0 at p = 0, not NaN
+        logits = np.array([[0.0, -np.inf, -1e4]], np.float32)
+        d = Categorical(logits)
+        assert np.isfinite(d.entropy()).all()
+        np.testing.assert_allclose(d.entropy(), 0.0, atol=1e-6)
+        kl = d.kl_divergence(Categorical(np.zeros((1, 3), np.float32)))
+        np.testing.assert_allclose(kl, np.log(3.0), rtol=1e-6)
+        # grads through a saturated entropy stay finite too
+        g = jax.grad(lambda lg: Categorical(lg).entropy().sum())(
+            jnp.array([[60.0, -60.0, 0.0]], jnp.float32))
+        assert np.isfinite(g).all()
+
+    def test_masked_logits_grads_finite(self):
+        # -inf logits are the action-masking idiom; entropy/KL grads must
+        # not NaN through the masked classes (double-where)
+        logits = jnp.array([[0.0, -jnp.inf, 1.0]], jnp.float32)
+        g = jax.grad(lambda lg: Categorical(lg).entropy().sum())(logits)
+        assert np.isfinite(np.asarray(g)[0, [0, 2]]).all()
+        assert not np.isnan(np.asarray(g)).any()
+        gkl = jax.grad(lambda lg: Categorical(lg).kl_divergence(
+            Categorical(jnp.zeros((1, 3), jnp.float32))).sum())(logits)
+        assert not np.isnan(np.asarray(gkl)).any()
+
+    def test_batched_entropy_shape(self):
+        d = Categorical(np.zeros((4, 7), np.float32))
+        assert d.entropy().shape == (4, 1)  # keepdims like the reference
+
+
+class TestMultivariateNormalDiag:
+    def _pair(self):
+        a = MultivariateNormalDiag(np.array([0.3, 0.5], np.float32),
+                                   np.diag([0.4, 0.5]).astype(np.float32))
+        b = MultivariateNormalDiag(np.array([0.2, 0.4], np.float32),
+                                   np.diag([0.3, 0.4]).astype(np.float32))
+        return a, b
+
+    def test_reference_doc_example(self):
+        # reference docstring values (distributions.py:538-543)
+        a, b = self._pair()
+        np.testing.assert_allclose(a.entropy(), 2.033158, rtol=1e-5)
+        np.testing.assert_allclose(b.entropy(), 1.7777451, rtol=1e-5)
+        np.testing.assert_allclose(a.kl_divergence(b), 0.06542051, rtol=1e-4)
+
+    def test_entropy_matches_scipy(self):
+        a, _ = self._pair()
+        ref = st.multivariate_normal([0.3, 0.5], np.diag([0.4, 0.5])).entropy()
+        np.testing.assert_allclose(a.entropy(), ref, rtol=1e-5)
+
+    def test_log_prob_matches_scipy(self):
+        a, _ = self._pair()
+        x = np.array([0.1, 0.9])
+        ref = st.multivariate_normal([0.3, 0.5], np.diag([0.4, 0.5])).logpdf(x)
+        np.testing.assert_allclose(a.log_prob(x), ref, rtol=1e-5)
+
+    def test_sample_moments(self):
+        a, _ = self._pair()
+        s = a.sample((20000,), key=jax.random.PRNGKey(0))
+        assert s.shape == (20000, 2)
+        np.testing.assert_allclose(s.mean(0), [0.3, 0.5], atol=0.02)
+        np.testing.assert_allclose(s.var(0), [0.4, 0.5], atol=0.02)
+
+    def test_rejects_nonsquare_scale(self):
+        with pytest.raises(ValueError):
+            MultivariateNormalDiag(np.zeros(2), np.zeros((2, 3)))
+
+
+def test_default_sample_is_fresh():
+    # no key/seed -> a fresh draw per call (reference seed=0 semantics);
+    # identical repeated draws would silently break Monte Carlo loops
+    a = Normal(0.0, 1.0).sample((4,))
+    b = Normal(0.0, 1.0).sample((4,))
+    assert not np.allclose(a, b)
+    # explicit seed stays reproducible
+    s1 = Normal(0.0, 1.0).sample((4,), seed=7)
+    s2 = Normal(0.0, 1.0).sample((4,), seed=7)
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_uniform_own_sample_in_support():
+    # jax.random.uniform includes 0.0 -> sample can be exactly `low`;
+    # log_prob of a self-drawn sample must be finite
+    d = Uniform(2.0, 3.0)
+    assert np.isfinite(d.log_prob(2.0))
+    assert np.isneginf(d.log_prob(3.0))
+
+
+def test_functional_kl():
+    p, q = Normal(0.0, 1.0), Normal(0.5, 1.5)
+    np.testing.assert_allclose(kl_divergence(p, q), p.kl_divergence(q))
+
+
+def test_type_errors():
+    with pytest.raises(TypeError):
+        Normal(0.0, 1.0).kl_divergence(Uniform(0.0, 1.0))
+    with pytest.raises(TypeError):
+        Categorical(np.zeros(3)).kl_divergence(Normal(0.0, 1.0))

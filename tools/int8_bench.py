@@ -75,8 +75,8 @@ def main():
     out["int8_vs_float_latency"] = i8 / fl
     # numerical sanity: int8 path tracks float within quantization error
     # (reuse the executables' outputs — no recompilation)
-    d = float(jnp.max(jnp.abs(jnp.asarray(results["float32"][0]) -
-                              jnp.asarray(results["int8"][0]))))
+    d = float(jnp.max(jnp.abs(jnp.asarray(results["float32"]) -
+                              jnp.asarray(results["int8"]))))
     out["max_abs_diff"] = d
     print(json.dumps(out))
     return 0
