@@ -8,8 +8,10 @@ from paddle_tpu.models.resnet import ResNet, ResNet50
 from paddle_tpu.models.deepfm import DeepFM
 from paddle_tpu.models.transformer import Transformer, TransformerConfig
 from paddle_tpu.models.gpt import GPT, GPTConfig
-from paddle_tpu.models.book import (LinearRegression, RNNLanguageModel,
-                                    RecommenderSystem, SentimentLSTM,
+from paddle_tpu.models.book import (LinearRegression, MachineTranslation,
+                                    RNNLanguageModel,
+                                    RecommenderSystem, SentimentCNN,
+                                    SentimentLSTM,
                                     SkipGramNS, Word2Vec)
 from paddle_tpu.models.mobilenet import MobileNetV1, MobileNetV2
 from paddle_tpu.models.vgg import VGG, VGG16
@@ -29,6 +31,6 @@ from paddle_tpu.models.gan import (DCGANDiscriminator, DCGANGenerator,
 __all__ = ["LeNet", "BertConfig", "BertModel", "BertForPretraining",
            "ResNet", "ResNet50", "DeepFM", "Transformer",
            "TransformerConfig", "GPT", "GPTConfig", "LinearRegression",
-           "RNNLanguageModel", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
+           "MachineTranslation", "RNNLanguageModel", "SentimentCNN", "SentimentLSTM", "SkipGramNS", "Word2Vec", "RecommenderSystem",
            "MobileNetV1", "MobileNetV2", "VGG", "VGG16", "SEResNeXt",
            "SEResNeXt50", "AlexNet", "DarkNet53", "DenseNet121", "GoogLeNet", "ShuffleNetV2", "SqueezeNet", "SSD", "SSDConfig", "FasterRCNN", "FasterRCNNConfig", "MaskRCNN", "C3D", "TSN", "YOLOv3", "YOLOv3Config", "CRNN", "DCGANGenerator", "DCGANDiscriminator", "gan_step"]

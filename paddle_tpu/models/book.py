@@ -109,6 +109,37 @@ class SentimentLSTM(Layer):
         return nll, {"acc": acc}
 
 
+class SentimentCNN(Layer):
+    """understand_sentiment, conv variant (reference
+    ``test_understand_sentiment_conv_new_api.py:38`` ``convolution_net``):
+    embedding -> two ``sequence_conv_pool`` branches (filter sizes 3 and 4,
+    tanh, sqrt-pool) -> concat -> fc softmax."""
+
+    def __init__(self, vocab_size, num_classes=2, embed_dim=128,
+                 hidden=512):
+        super().__init__()
+        from paddle_tpu.nn.nets import SequenceConvPool
+        self.embed = Embedding(vocab_size, embed_dim,
+                               weight_init=I.normal(0.0, 0.02))
+        self.conv3 = SequenceConvPool(embed_dim, hidden, 3,
+                                      act="tanh", pool_type="sqrt")
+        self.conv4 = SequenceConvPool(embed_dim, hidden, 4,
+                                      act="tanh", pool_type="sqrt")
+        self.fc = Linear(2 * hidden, num_classes, sharding=None)
+
+    def forward(self, params, ids, lengths):
+        x = self.embed(params["embed"], ids)
+        h = jnp.concatenate([self.conv3(params["conv3"], x, lengths),
+                             self.conv4(params["conv4"], x, lengths)], -1)
+        return self.fc(params["fc"], h)
+
+    def loss(self, params, ids, lengths, label):
+        logits = self.forward(params, ids, lengths)
+        nll = ops_nn.softmax_with_cross_entropy(logits, label[:, None]).mean()
+        acc = (logits.argmax(-1) == label).mean()
+        return nll, {"acc": acc}
+
+
 class RNNLanguageModel(Layer):
     """LSTM LM (PaddleNLP language_model recipe): next-token prediction
     with tied-embedding option."""
@@ -186,6 +217,100 @@ class RecommenderSystem(Layer):
                             movie_id, categories)
         mse = jnp.mean((pred - rating) ** 2)
         return mse, {"mae": jnp.mean(jnp.abs(pred - rating))}
+
+
+class MachineTranslation(Layer):
+    """book/08.machine_translation (reference
+    ``test_machine_translation.py:40-160``): embedding -> tanh fc -> LSTM
+    encoder whose final hidden state seeds a plain-RNN decoder
+    (``state = tanh(fc([word_emb, state]))``, vocab softmax), decoded
+    with the reusable ``ops.beam_search`` ops — the lifted analogs of the
+    reference's ``pd.beam_search``/``pd.beam_search_decode`` graph ops.
+    Demonstrates the parent-pointer backtracking style (the transformer's
+    cached decoder shows the in-loop reorder style)."""
+
+    def __init__(self, src_vocab, trg_vocab, embed_dim=32, hidden=32,
+                 bos_id=1, eos_id=2, pad_id=0):
+        super().__init__()
+        from paddle_tpu.nn.rnn import RNN, LSTMCell
+        self.src_embed = Embedding(src_vocab, embed_dim,
+                                   weight_init=I.normal(0.0, 0.02))
+        self.trg_embed = Embedding(trg_vocab, embed_dim,
+                                   weight_init=I.normal(0.0, 0.02))
+        self.enc_fc = Linear(embed_dim, hidden, sharding=None)
+        self.encoder = RNN(LSTMCell(hidden, hidden))
+        self.dec_fc = Linear(embed_dim + hidden, hidden, sharding=None)
+        self.out = Linear(hidden, trg_vocab, sharding=None)
+        self.bos_id, self.eos_id, self.pad_id = bos_id, eos_id, pad_id
+
+    def encode(self, params, src_ids, src_lengths):
+        x = jnp.tanh(self.enc_fc(params["enc_fc"],
+                                 self.src_embed(params["src_embed"],
+                                                src_ids)))
+        _, (h, _) = self.encoder(params["encoder"], x, src_lengths)
+        return h                                             # (B, H)
+
+    def _dec_step(self, params, state, emb):
+        state = jnp.tanh(self.dec_fc(
+            params["dec_fc"], jnp.concatenate([emb, state], -1)))
+        return state, self.out(params["out"], state)
+
+    def forward(self, params, src_ids, src_lengths, trg_ids):
+        """Teacher-forced logits (B, T, V) for trg_ids (B, T) inputs."""
+        ctx = self.encode(params, src_ids, src_lengths)
+        emb = self.trg_embed(params["trg_embed"], trg_ids)   # (B, T, E)
+
+        def scan_fn(state, emb_t):
+            state, logits = self._dec_step(params, state, emb_t)
+            return state, logits
+
+        _, logits = jax.lax.scan(scan_fn, ctx,
+                                 jnp.swapaxes(emb, 0, 1))
+        return jnp.swapaxes(logits, 0, 1)
+
+    def loss(self, params, src_ids, src_lengths, trg_in, trg_out,
+             trg_lengths):
+        logits = self.forward(params, src_ids, src_lengths, trg_in)
+        nll = ops_nn.softmax_with_cross_entropy(logits, trg_out[..., None])
+        mask = seq_ops.sequence_mask(trg_lengths, trg_in.shape[1],
+                                     logits.dtype)
+        return (nll[..., 0] * mask).sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    def beam_search_translate(self, params, src_ids, src_lengths, *,
+                              beam_size=4, max_len=16,
+                              length_penalty=0.0):
+        """Beam decode via ops.beam_search_step / gather_beams /
+        beam_search_decode. Returns (seqs (B, K, max_len+1) starting with
+        BOS, scores (B, K)), best-first."""
+        from paddle_tpu.ops import beam_search as bs
+        b = src_ids.shape[0]
+        k = beam_size
+        ctx = self.encode(params, src_ids, src_lengths)
+        state = jnp.repeat(ctx[:, None, :], k, axis=1)       # (B, K, H)
+        scores, done = bs.beam_init(b, k)
+        tok = jnp.full((b, k), self.bos_id, jnp.int32)
+
+        def step(carry, _):
+            tok, state, scores, done = carry
+            emb = self.trg_embed(params["trg_embed"], tok)   # (B, K, E)
+            h = state.reshape(b * k, -1)
+            h, logits = self._dec_step(params, h,
+                                       emb.reshape(b * k, -1))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tok, scores, done, parent = bs.beam_search_step(
+                logp.reshape(b, k, -1), scores, done,
+                eos_id=self.eos_id, pad_id=self.pad_id)
+            state = bs.gather_beams(h.reshape(b, k, -1), parent)
+            return (tok, state, scores, done), (tok, parent)
+
+        (_, _, scores, _), (toks, parents) = jax.lax.scan(
+            step, (tok, state, scores, done), None, length=max_len)
+        toks = jnp.moveaxis(toks, 0, 1)                      # (B, T, K)
+        parents = jnp.moveaxis(parents, 0, 1)
+        return bs.beam_search_decode(
+            toks, parents, scores, eos_id=self.eos_id,
+            pad_id=self.pad_id, bos_id=self.bos_id,
+            length_penalty=length_penalty)
 
 
 class LabelSemanticRoles(Layer):

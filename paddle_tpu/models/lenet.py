@@ -1,29 +1,30 @@
 """LeNet-5 for MNIST — BASELINE.json config 1 (book/02.recognize_digits,
 reference model ``python/paddle/fluid/tests/book/test_recognize_digits.py``
-``convolutional_neural_network``: conv-pool ×2 then fc-softmax)."""
+``convolutional_neural_network``: two ``simple_img_conv_pool`` stages then
+fc-softmax — built on the same composite here)."""
 
 from __future__ import annotations
 
 from paddle_tpu import nn
+from paddle_tpu.nn.nets import SimpleImgConvPool
 from paddle_tpu.ops import activation as A
-from paddle_tpu.ops import nn as F
 from paddle_tpu.ops import tensor as T
 
 
 class LeNet(nn.Layer):
     def __init__(self, num_classes=10):
         super().__init__()
-        self.conv1 = nn.Conv2D(1, 20, 5)
-        self.conv2 = nn.Conv2D(20, 50, 5)
+        self.conv_pool1 = SimpleImgConvPool(1, 20, 5, pool_size=2,
+                                            pool_stride=2, act="relu")
+        self.conv_pool2 = SimpleImgConvPool(20, 50, 5, pool_size=2,
+                                            pool_stride=2, act="relu")
         self.fc1 = nn.Linear(4 * 4 * 50, 500, sharding=None)
         self.fc2 = nn.Linear(500, num_classes, sharding=None)
 
     def forward(self, params, x):
         # x: [N, 28, 28, 1] NHWC
-        h = A.relu(self.conv1(params["conv1"], x))        # [N,24,24,20]
-        h = F.pool2d(h, 2, 2)                             # [N,12,12,20]
-        h = A.relu(self.conv2(params["conv2"], h))        # [N,8,8,50]
-        h = F.pool2d(h, 2, 2)                             # [N,4,4,50]
+        h = self.conv_pool1(params["conv_pool1"], x)      # [N,12,12,20]
+        h = self.conv_pool2(params["conv_pool2"], h)      # [N,4,4,50]
         h = T.flatten(h, 1)                               # [N,800]
         h = A.relu(self.fc1(params["fc1"], h))
         return self.fc2(params["fc2"], h)                 # logits

@@ -409,12 +409,12 @@ class Transformer(Layer):
         caches, reordered alongside the beams at every step — the
         reference's cached beam decoder; the uncached path refeeds
         every prefix each step."""
+        from paddle_tpu.ops import beam_search as bs
         cfg = self.cfg
         max_len = max_len or cfg.max_len
         b = src_ids.shape[0]
         k = beam_size
         v = cfg.vocab_size
-        NEG = -1e9
 
         memory, memory_bias = self.encode(params, src_ids,
                                           pipelined=False)
@@ -424,44 +424,26 @@ class Transformer(Layer):
 
         tgt = jnp.full((b, k, max_len), cfg.pad_id, jnp.int32)
         tgt = tgt.at[:, :, 0].set(cfg.bos_id)
-        # beam 0 active, others start at -inf so step 1 fans out
-        scores = jnp.tile(jnp.array([0.0] + [NEG] * (k - 1)), (b, 1))
-        done = jnp.zeros((b, k), bool)
+        scores, done = bs.beam_init(b, k)
 
         def penalty(length):
             return ((5.0 + length) / 6.0) ** length_penalty
 
         def select(logits_t, t, tgt, scores, done):
-            """Shared beam bookkeeping. logits_t (B*K, V) at step t.
-            Returns (tgt, scores, done, src_beam)."""
+            """Beam bookkeeping via the reusable ops.beam_search_step;
+            logits_t (B*K, V) at step t. Returns (tgt, scores, done,
+            src_beam)."""
             logp = jax.nn.log_softmax(logits_t.astype(jnp.float32), -1)
-            logp = logp.reshape(b, k, v)
-            # finished beams: only PAD continuation, score unchanged
-            pad_only = jnp.full((v,), NEG).at[cfg.pad_id].set(0.0)
-            logp = jnp.where(done[..., None], pad_only[None, None, :],
-                             logp)
-            cand = scores[..., None] + logp                    # (B, K, V)
-            flat = cand.reshape(b, k * v)
-            new_scores, idx = jax.lax.top_k(flat, k)           # (B, K)
-            src_beam = idx // v
-            tok = (idx % v).astype(jnp.int32)
+            tok, scores, done, src_beam = bs.beam_search_step(
+                logp.reshape(b, k, v), scores, done,
+                eos_id=cfg.eos_id, pad_id=cfg.pad_id)
             tgt = jnp.take_along_axis(tgt, src_beam[..., None], axis=1)
             tgt = tgt.at[:, :, t + 1].set(tok)
-            done = jnp.take_along_axis(done, src_beam, axis=1)
-            done = done | (tok == cfg.eos_id)
-            return tgt, new_scores, done, src_beam
+            return tgt, scores, done, src_beam
 
         if use_cache:
             caches, cross = self._decode_state(params, memory, max_len,
                                                beam_expand=k)
-
-            def reorder(cache_leaf, src_beam):
-                # (B*K, ...) rows follow their beams
-                shaped = cache_leaf.reshape((b, k) + cache_leaf.shape[1:])
-                ix = src_beam.reshape(
-                    (b, k) + (1,) * (cache_leaf.ndim - 1))
-                shaped = jnp.take_along_axis(shaped, ix, axis=1)
-                return shaped.reshape(cache_leaf.shape)
 
             def body(t, carry):
                 tgt, scores, done, caches = carry
@@ -470,8 +452,8 @@ class Transformer(Layer):
                     caches, cross, mem_bias, max_len)
                 tgt, scores, done, src_beam = select(
                     logits, t, tgt, scores, done)
-                caches = jax.tree_util.tree_map(
-                    lambda a: reorder(a, src_beam), caches)
+                # KV caches ride with their beams (flat B*K rows)
+                caches = bs.gather_beams(caches, src_beam)
                 return tgt, scores, done, caches
 
             tgt, scores, done, _ = jax.lax.fori_loop(

@@ -2,6 +2,9 @@
 
 from paddle_tpu.nn import initializer
 from paddle_tpu.nn import distributions
+from paddle_tpu.nn import nets
+from paddle_tpu.nn.nets import (ImgConvGroup, SequenceConvPool,
+                                SimpleImgConvPool, glu)
 from paddle_tpu.nn.distributions import (Categorical, Distribution,
                                          MultivariateNormalDiag, Normal,
                                          Uniform)
@@ -20,6 +23,7 @@ from paddle_tpu.nn.rnn import (BiRNN, GRUCell, LSTM, LSTMCell, LSTMPCell,
 __all__ = [
     "initializer", "distributions", "Categorical", "Distribution",
     "MultivariateNormalDiag", "Normal", "Uniform",
+    "nets", "ImgConvGroup", "SequenceConvPool", "SimpleImgConvPool", "glu",
     "Layer", "LayerList", "ParamSpec", "Sequential",
     "apply_state_updates", "capture_state", "report_state",
     "FC", "BatchNorm", "Conv2D", "Dropout", "Embedding", "LayerNorm",

@@ -1,0 +1,157 @@
+"""Composite network helpers (``fluid.nets`` parity).
+
+Reference: ``python/paddle/fluid/nets.py:1-533`` — ``simple_img_conv_pool``
+(:28), ``img_conv_group`` (:136), ``sequence_conv_pool`` (:249), ``glu``
+(:405). (``scaled_dot_product_attention``, :444, lives in
+``paddle_tpu.ops.attention``.)
+
+The reference's helpers are graph-building functions; here they are
+``Layer`` composites (this framework's module idiom) built from the same
+primitives — ``Conv2D``/``Pool2D``/``BatchNorm``/``Dropout`` and the
+``sequence_conv``/``sequence_pool`` ops — plus functional ``glu``.
+Input layout is NHWC (TPU-native), not the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.nn.layers import BatchNorm, Conv2D, Dropout, Pool2D
+from paddle_tpu.nn.module import Layer
+from paddle_tpu.ops import activation as A
+from paddle_tpu.ops import sequence as S
+
+__all__ = ["glu", "SimpleImgConvPool", "ImgConvGroup", "SequenceConvPool"]
+
+
+@register_op("glu", has_grad=True)
+def glu(x, axis: int = -1):
+    """Gated Linear Unit: split ``x`` in two along ``axis``, gate the first
+    half with the sigmoid of the second (reference ``nets.py:405`` — split +
+    sigmoid + elementwise_mul; one fused XLA expression here)."""
+    if x.shape[axis] % 2:
+        raise ValueError(f"glu axis dim must be even, got {x.shape[axis]}")
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * A.sigmoid(b)
+
+
+_ACTS = {None: lambda x: x, "relu": A.relu, "sigmoid": A.sigmoid,
+         "tanh": A.tanh, "gelu": A.gelu, "swish": A.swish}
+
+
+def _act(name):
+    if callable(name):
+        return name
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}") from None
+
+
+class SimpleImgConvPool(Layer):
+    """One Conv2D + one Pool2D (reference ``nets.py:28``
+    ``simple_img_conv_pool``). NHWC input."""
+
+    def __init__(self, in_channels, num_filters, filter_size, pool_size,
+                 pool_stride, pool_padding=0, pool_type="max",
+                 global_pooling=False, conv_stride=1, conv_padding=0,
+                 conv_dilation=1, conv_groups=1, act=None, bias=True):
+        super().__init__()
+        self.conv = Conv2D(in_channels, num_filters, filter_size,
+                           stride=conv_stride, padding=conv_padding,
+                           dilation=conv_dilation, groups=conv_groups,
+                           bias=bias)
+        self.pool = Pool2D(pool_size, pool_stride, pool_padding,
+                           pool_type=pool_type,
+                           global_pooling=global_pooling)
+        self.act = _act(act)
+
+    def forward(self, params, x):
+        return self.pool(None, self.act(self.conv(params["conv"], x)))
+
+
+def _extend(obj, n, what):
+    if isinstance(obj, (list, tuple)):
+        if len(obj) != n:
+            raise ValueError(f"{what} length {len(obj)} != {n} conv layers")
+        return list(obj)
+    return [obj] * n
+
+
+class ImgConvGroup(Layer):
+    """Serial Conv2D[+BatchNorm][+Dropout] stack followed by one Pool2D
+    (reference ``nets.py:136`` ``img_conv_group`` — the VGG building
+    block). Per-layer settings broadcast like the reference's
+    ``__extend_list__``. NHWC input."""
+
+    def __init__(self, in_channels, conv_num_filter: Sequence[int],
+                 pool_size, conv_padding=1, conv_filter_size=3,
+                 conv_act=None, conv_with_batchnorm: Union[bool, list] = False,
+                 conv_batchnorm_drop_rate: Union[float, list] = 0.0,
+                 pool_stride=1, pool_type="max"):
+        super().__init__()
+        n = len(conv_num_filter)
+        pad = _extend(conv_padding, n, "conv_padding")
+        fs = _extend(conv_filter_size, n, "conv_filter_size")
+        self.with_bn = _extend(conv_with_batchnorm, n, "conv_with_batchnorm")
+        self.drop = _extend(conv_batchnorm_drop_rate, n,
+                            "conv_batchnorm_drop_rate")
+        self.act = _act(conv_act)
+        c = in_channels
+        for i, f in enumerate(conv_num_filter):
+            self.add_sublayer(f"conv{i}",
+                              Conv2D(c, f, fs[i], padding=pad[i]))
+            if self.with_bn[i]:
+                self.add_sublayer(f"bn{i}", BatchNorm(f))
+                if abs(self.drop[i]) > 1e-5:
+                    self.add_sublayer(f"dropout{i}", Dropout(self.drop[i]))
+            c = f
+        self.n = n
+        self.pool = Pool2D(pool_size, pool_stride, pool_type=pool_type)
+
+    def forward(self, params, x, *, training=False, dropout_key=None):
+        h = x
+        for i in range(self.n):
+            h = getattr(self, f"conv{i}")(params[f"conv{i}"], h)
+            if self.with_bn[i]:
+                # activation rides AFTER BN when BN is present (:225-230)
+                h = getattr(self, f"bn{i}")(params[f"bn{i}"], h,
+                                            training=training)
+                h = self.act(h)
+                if abs(self.drop[i]) > 1e-5:
+                    h = getattr(self, f"dropout{i}")(
+                        None, h, key=dropout_key, training=training)
+            else:
+                h = self.act(h)
+        return self.pool(None, h)
+
+
+class SequenceConvPool(Layer):
+    """Context-window sequence conv + sequence pool (reference
+    ``nets.py:249`` ``sequence_conv_pool`` — the text-CNN building block).
+    Input is padded ``(B, T, D)`` + ``lengths``, the TPU-native packing of
+    the reference's LoD rows."""
+
+    def __init__(self, input_dim, num_filters, filter_size,
+                 act="sigmoid", pool_type="max", bias=True):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+        self.filter = self.create_parameter(
+            "filter", (filter_size * input_dim, num_filters),
+            initializer=I.xavier_uniform())
+        self.has_bias = bias
+        if bias:
+            self.bias = self.create_parameter(
+                "bias", (num_filters,), initializer=I.zeros)
+        self.act = _act(act)
+        self.pool_type = pool_type
+
+    def forward(self, params, x, lengths):
+        h = S.sequence_conv(x, lengths, params["filter"])
+        if self.has_bias:
+            h = h + params["bias"]
+        h = self.act(h)
+        return S.sequence_pool(h, lengths, pool_type=self.pool_type)

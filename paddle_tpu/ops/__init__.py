@@ -5,9 +5,11 @@ Importing this package registers every op into the OpInfoMap
 pattern (op_registry.h:199) without global constructors.
 """
 
-from paddle_tpu.ops import (activation, attention, crf, detection,
-                            elementwise, math, metrics_ops, niche, nn,
-                            reduction, sequence, tensor)
+from paddle_tpu.ops import (activation, attention, beam_search, crf,
+                            detection, elementwise, math, metrics_ops,
+                            niche, nn, reduction, sequence, tensor)
+from paddle_tpu.ops.beam_search import (beam_init, beam_search_decode,  # noqa: F401
+                                        beam_search_step, gather_beams)
 from paddle_tpu.ops.attention import (dot_product_attention,  # noqa: F401
                                       flash_attention,
                                       scaled_dot_product_attention)

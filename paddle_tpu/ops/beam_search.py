@@ -1,0 +1,149 @@
+"""Reusable beam-search ops (``beam_search_op`` / ``beam_search_decode_op``).
+
+Reference: ``paddle/fluid/operators/beam_search_op.cc`` (one expansion step:
+pre_ids/pre_scores -> selected_ids/selected_scores/parent_idx, grouped per
+source sentence) and ``beam_search_decode_op.cc`` (walk the parent pointers
+of every step's selections back into full sentences + scores).
+
+TPU-native: static shapes throughout. Beams live on a dense ``(B, K)``
+lattice (the batch dimension replaces the reference's LoD beam segments —
+segment-aware grouping = the leading axis), finished beams are masked
+rather than pruned, and the per-step op composes with ``lax.scan``/
+``fori_loop`` so whole decodes stay inside one XLA program. Backtracking
+in :func:`beam_search_decode` is a reverse ``lax.scan`` over parent
+pointers instead of the reference's host-side sentence walk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+NEG_INF = -1e9
+
+__all__ = ["beam_search_step", "beam_search_decode", "beam_init",
+           "gather_beams", "NEG_INF"]
+
+
+def beam_init(batch: int, beam_size: int, dtype=jnp.float32):
+    """Initial ``(scores, done)`` lattice: beam 0 live at score 0, beams
+    1..K-1 at -inf so the first step fans out from a single hypothesis
+    (reference ``beam_search_op``'s first-step LoD of one candidate)."""
+    scores = jnp.tile(
+        jnp.array([0.0] + [NEG_INF] * (beam_size - 1), dtype), (batch, 1))
+    done = jnp.zeros((batch, beam_size), bool)
+    return scores, done
+
+
+@register_op("beam_search", has_grad=False)
+def beam_search_step(logp, scores, done, *, eos_id: int, pad_id: int = 0,
+                     beam_size: Optional[int] = None):
+    """One beam expansion (reference ``beam_search_op.cc``).
+
+    Args:
+      logp: (B, K, V) next-token log-probabilities per live beam.
+      scores: (B, K) cumulative log-probs (``pre_scores``).
+      done: (B, K) bool — beams that already emitted EOS.
+      eos_id / pad_id: termination token / filler for finished beams.
+      beam_size: output beams per group; defaults to K (shrinking mid-
+        decode is allowed, growing is not).
+
+    Returns ``(tokens, new_scores, new_done, parent_idx)``, each (B, K'):
+    the chosen continuation tokens, their cumulative scores, finished
+    flags, and the index of the beam each selection extends — the
+    reference op's ``selected_ids``/``selected_scores``/``parent_idx``.
+    Finished beams only ever continue with ``pad_id`` at unchanged score.
+    """
+    b, k, v = logp.shape
+    k_out = beam_size or k
+    if k_out > k:
+        raise ValueError(f"cannot grow beams: {k_out} > {k}")
+    logp = logp.astype(jnp.float32)
+    # a finished beam contributes exactly one candidate: PAD, score += 0
+    pad_only = jnp.full((v,), NEG_INF, logp.dtype).at[pad_id].set(0.0)
+    logp = jnp.where(done[..., None], pad_only[None, None, :], logp)
+    cand = scores[..., None] + logp                       # (B, K, V)
+    new_scores, idx = jax.lax.top_k(cand.reshape(b, k * v), k_out)
+    parent = idx // v
+    tok = (idx % v).astype(jnp.int32)
+    new_done = jnp.take_along_axis(done, parent, axis=1) | (tok == eos_id)
+    return tok, new_scores, new_done, parent
+
+
+def gather_beams(tree, parent_idx):
+    """Reorder per-beam state along the chosen parents: every leaf of
+    ``tree`` has leading dims ``(B, K, ...)`` or flat ``(B*K, ...)`` and
+    its rows follow ``parent_idx`` (B, K). The companion to the reference
+    op's ``parent_idx`` output — used to carry RNN hidden state or KV
+    caches along with their beams."""
+    b, k = parent_idx.shape
+
+    def g(leaf):
+        flat = leaf.shape[0] == b * k
+        shaped = leaf.reshape((b, k) + leaf.shape[1:]) if flat else leaf
+        ix = parent_idx.reshape((b, k) + (1,) * (shaped.ndim - 2))
+        shaped = jnp.take_along_axis(shaped, ix, axis=1)
+        return shaped.reshape(leaf.shape) if flat else shaped
+
+    return jax.tree_util.tree_map(g, tree)
+
+
+@register_op("beam_search_decode", has_grad=False)
+def beam_search_decode(step_tokens, step_parents, scores, *,
+                       eos_id: int, pad_id: int = 0, bos_id: Optional[int] = None,
+                       length_penalty: float = 0.0):
+    """Backtrack stacked step selections into full sequences (reference
+    ``beam_search_decode_op.cc``).
+
+    Args:
+      step_tokens: (B, T, K) tokens chosen at each step (the scan stack of
+        :func:`beam_search_step`'s ``tokens``).
+      step_parents: (B, T, K) matching ``parent_idx`` stack.
+      scores: (B, K) final cumulative scores.
+      bos_id: when given, sequences are prefixed with it (length T+1).
+      length_penalty: GNMT alpha; 0 ranks by raw cumulative score like the
+        reference op, >0 divides by ((5+len)/6)^alpha.
+
+    Returns ``(sequences, norm_scores)``: (B, K, T[+1]) int32 sequences,
+    post-EOS filled with ``pad_id``, and the (possibly length-normalized)
+    scores, both sorted best-first.
+    """
+    b, t, k = step_tokens.shape
+    toks = jnp.moveaxis(step_tokens, 1, 0)     # (T, B, K)
+    pars = jnp.moveaxis(step_parents, 1, 0)
+
+    # walk parents right-to-left: the beam that holds slot j at the end
+    # occupied pars[t, :, j] at step t-1
+    def back(ptr, inp):
+        tok_t, par_t = inp
+        tok = jnp.take_along_axis(tok_t, ptr, axis=1)      # (B, K)
+        ptr = jnp.take_along_axis(par_t, ptr, axis=1)
+        return ptr, tok
+
+    ptr0 = jnp.tile(jnp.arange(k)[None, :], (b, 1))
+    _, rev = jax.lax.scan(back, ptr0, (toks[::-1], pars[::-1]))
+    seqs = jnp.moveaxis(rev[::-1], 0, 1)                   # (B, T, K)
+    seqs = jnp.moveaxis(seqs, 2, 1).astype(jnp.int32)      # (B, K, T)
+
+    # mask everything after the first EOS to pad (keep the EOS itself)
+    is_eos = seqs == eos_id
+    after = jnp.cumsum(jnp.cumsum(is_eos, axis=-1), axis=-1) > 1
+    seqs = jnp.where(after, pad_id, seqs)
+
+    if length_penalty > 0.0:
+        lengths = (seqs != pad_id).sum(-1).astype(jnp.float32)
+        if bos_id is not None:
+            lengths = lengths + 1.0
+        scores = scores / (((5.0 + lengths) / 6.0) ** length_penalty)
+
+    order = jnp.argsort(-scores, axis=-1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    if bos_id is not None:
+        bos = jnp.full((b, k, 1), bos_id, jnp.int32)
+        seqs = jnp.concatenate([bos, seqs], axis=-1)
+    return seqs, scores

@@ -166,8 +166,8 @@ class TestQAT:
         from paddle_tpu import slim
         _, params, _ = self._setup()
         q = slim.qat_convert(params, bit_length=8)
-        leaf = np.asarray(params["conv1"]["weight"])
-        qleaf = np.asarray(q["conv1"]["weight"])
+        leaf = np.asarray(params["conv_pool1"]["conv"]["weight"])
+        qleaf = np.asarray(q["conv_pool1"]["conv"]["weight"])
         assert qleaf.shape == leaf.shape
         # values snapped to a 2^7-step grid of the abs-max scale
         scale = float(np.abs(leaf).max()) / 127.0

@@ -1,0 +1,177 @@
+"""Composite-net helpers (``fluid.nets`` parity): glu, SimpleImgConvPool,
+ImgConvGroup, SequenceConvPool + the book models built on them.
+
+Reference: ``python/paddle/fluid/nets.py:28,136,249,405`` and the book's
+``test_understand_sentiment_conv_new_api.py:38`` convolution_net.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.nets import (ImgConvGroup, SequenceConvPool,
+                                SimpleImgConvPool, glu)
+
+
+class TestGlu:
+    def test_matches_manual_split(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        out = glu(x, axis=-1)
+        a, b = x[:, :3], x[:, 3:]
+        np.testing.assert_allclose(out, a / (1 + np.exp(-b)), rtol=1e-5)
+        assert out.shape == (4, 3)
+
+    def test_axis_and_grad(self):
+        x = jnp.arange(8.0).reshape(2, 2, 2)
+        assert glu(x, axis=0).shape == (1, 2, 2)
+        g = jax.grad(lambda x: glu(x).sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_odd_dim_raises(self):
+        with pytest.raises(ValueError):
+            glu(jnp.zeros((2, 3)))
+
+    def test_registered(self):
+        from paddle_tpu.core.registry import get_op
+        assert get_op("glu").fn is glu
+
+
+class TestSimpleImgConvPool:
+    def test_shapes_match_reference_lenet_stage(self):
+        # conv(5x5, valid) then pool(2,2): 28 -> 24 -> 12, like the
+        # reference's recognize_digits first stage
+        m = SimpleImgConvPool(1, 20, 5, pool_size=2, pool_stride=2,
+                              act="relu")
+        p = m.init(jax.random.PRNGKey(0))
+        y = m(p, jnp.ones((2, 28, 28, 1)))
+        assert y.shape == (2, 12, 12, 20)
+        assert (np.asarray(y) >= 0).all()  # relu applied
+
+    def test_global_pooling(self):
+        m = SimpleImgConvPool(3, 8, 3, pool_size=2, pool_stride=2,
+                              conv_padding=1, global_pooling=True,
+                              pool_type="avg")
+        p = m.init(jax.random.PRNGKey(0))
+        assert m(p, jnp.ones((2, 16, 16, 3))).shape == (2, 1, 1, 8)
+
+    def test_trains(self):
+        m = SimpleImgConvPool(1, 4, 3, pool_size=2, pool_stride=2,
+                              act="relu")
+        p = m.init(jax.random.PRNGKey(0))
+        g = jax.grad(lambda p, x: m(p, x).sum())(p, jnp.ones((1, 8, 8, 1)))
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree.leaves(g))
+
+
+class TestImgConvGroup:
+    def test_vgg_block_shapes(self):
+        # the VGG building block the reference builds img_conv_group for:
+        # two 3x3 same convs + BN + 2x2 pool
+        m = ImgConvGroup(3, [8, 8], pool_size=2, pool_stride=2,
+                         conv_act="relu", conv_with_batchnorm=True)
+        p = m.init(jax.random.PRNGKey(0))
+        y = m(p, jnp.ones((2, 32, 32, 3)))
+        assert y.shape == (2, 16, 16, 8)
+
+    def test_per_layer_broadcast_and_validation(self):
+        m = ImgConvGroup(3, [4, 8], pool_size=2, conv_padding=[1, 0],
+                         conv_filter_size=[3, 5], pool_stride=2)
+        p = m.init(jax.random.PRNGKey(0))
+        # 16 ->(3x3 pad1) 16 ->(5x5 pad0) 12 ->(pool2/2) 6
+        assert m(p, jnp.ones((1, 16, 16, 3))).shape == (1, 6, 6, 8)
+        with pytest.raises(ValueError):
+            ImgConvGroup(3, [4, 8], pool_size=2, conv_padding=[1, 0, 1])
+
+    def test_dropout_only_in_training(self):
+        m = ImgConvGroup(1, [4], pool_size=2, pool_stride=2,
+                         conv_with_batchnorm=True,
+                         conv_batchnorm_drop_rate=0.5, conv_act="relu")
+        p = m.init(jax.random.PRNGKey(0))
+        x = jnp.ones((2, 8, 8, 1))
+        y1 = m(p, x)                      # eval: deterministic
+        y2 = m(p, x)
+        np.testing.assert_array_equal(y1, y2)
+        yt = m(p, x, training=True, dropout_key=jax.random.PRNGKey(1))
+        assert not np.allclose(y1, yt)
+
+
+class TestSequenceConvPool:
+    def test_shapes_and_masking(self):
+        m = SequenceConvPool(8, 16, 3, act="tanh", pool_type="sqrt")
+        p = m.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 10, 8),
+                        jnp.float32)
+        lengths = jnp.array([10, 7, 3, 1])
+        y = m(p, x, lengths)
+        assert y.shape == (4, 16)
+        # padding must not influence the pooled output: perturb the padded
+        # tail of row 2 and expect identical pooling
+        x2 = x.at[2, 3:].set(99.0)
+        np.testing.assert_allclose(y[2], m(p, x2, lengths)[2], atol=1e-6)
+
+    def test_max_pool_variant(self):
+        m = SequenceConvPool(4, 6, 4, act="sigmoid", pool_type="max")
+        p = m.init(jax.random.PRNGKey(0))
+        y = m(p, jnp.ones((2, 5, 4)), jnp.array([5, 2]))
+        assert y.shape == (2, 6)
+        assert (np.asarray(y) >= 0).all() and (np.asarray(y) <= 1).all()
+
+
+class TestBookModelsOnComposites:
+    def test_lenet_still_converges(self):
+        # LeNet now composes SimpleImgConvPool; must still learn
+        from paddle_tpu.models import LeNet
+        from paddle_tpu.optimizer import Adam
+        from paddle_tpu.ops import nn as ops_nn
+        model = LeNet(num_classes=4)
+        params = model.init(jax.random.PRNGKey(0))
+        opt = Adam(learning_rate=1e-3)
+        state = opt.init(params)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 4, 64)
+
+        @jax.jit
+        def step(params, state, x, y):
+            def loss_fn(p):
+                return ops_nn.softmax_with_cross_entropy(
+                    model.forward(p, x), y[:, None]).mean()
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.update(g, state, params)
+            return params, state, loss
+
+        first = None
+        for i in range(30):
+            params, state, loss = step(params, state, jnp.asarray(x),
+                                       jnp.asarray(y))
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.5, (first, float(loss))
+
+    def test_sentiment_cnn_trains(self):
+        from paddle_tpu.models import SentimentCNN
+        from paddle_tpu.optimizer import Adam
+        model = SentimentCNN(vocab_size=50, num_classes=2, embed_dim=8,
+                             hidden=8)
+        params = model.init(jax.random.PRNGKey(0))
+        opt = Adam(learning_rate=1e-2)
+        state = opt.init(params)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 50, (16, 12)))
+        lengths = jnp.asarray(rng.randint(4, 13, 16))
+        # learnable signal: label = parity of first token
+        label = jnp.asarray(np.asarray(ids)[:, 0] % 2)
+
+        @jax.jit
+        def step(params, state):
+            (loss, aux), g = jax.value_and_grad(
+                model.loss, has_aux=True)(params, ids, lengths, label)
+            params, state = opt.update(g, state, params)
+            return params, state, loss, aux
+
+        losses = []
+        for _ in range(40):
+            params, state, loss, aux = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
