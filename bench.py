@@ -54,6 +54,74 @@ def count_params(tree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
+def metrics_log_path() -> str:
+    """Where this bench run's JSONL telemetry goes (observability.runlog
+    schema). Overridable so CI/scrapers can collect it."""
+    import os
+    return os.environ.get("PADDLE_TPU_METRICS_LOG",
+                          "/tmp/paddle_tpu_bench_metrics.jsonl")
+
+
+def write_bench_telemetry(result: dict) -> str | None:
+    """Emit the bench run through the observability subsystem: one JSONL
+    step record per timed step (same numbers as the stdout JSON), a
+    summary record, registry gauges, and a Prometheus exposition dump
+    next to the log. Then schema-validate the log by INVOKING
+    tools/check_metrics_log.py — malformed telemetry fails the bench
+    (an 'error' field in the JSON line) instead of polluting BENCH_*.
+
+    Returns the log path, or None when the bench produced no telemetry
+    (error runs)."""
+    import os
+    import subprocess
+
+    from paddle_tpu import observability as obs
+
+    tel = result.pop("_telemetry", None)
+    if tel is None:
+        return None
+    path = metrics_log_path()
+    try:
+        if os.path.exists(path):
+            os.remove(path)  # one bench run == one log
+    except OSError:
+        pass
+    steps = max(int(tel["steps"]), 1)
+    dt = float(tel["dt"])
+    per_step = dt / steps
+    ex = float(tel.get("examples_per_step", 0.0))
+    tok = tel.get("tokens_per_step")
+    with obs.RunLogWriter(path, meta={"bench": result.get("metric")}) as w:
+        for i in range(steps):
+            rec = {"step": i + 1,
+                   "step_time_s": round(per_step, 6),
+                   "examples_per_sec": round(ex / per_step, 3),
+                   "compiles_cum": obs.compile_count()}
+            if tok:
+                rec["tokens_per_sec"] = round(tok / per_step, 3)
+            w.write(rec)
+        w.write({"kind": "summary", "metric": result.get("metric"),
+                 "value": result.get("value"),
+                 "vs_baseline": result.get("vs_baseline")})
+    g = obs.gauge("bench_value", "headline bench metric value")
+    g.set(float(result.get("value") or 0.0),
+          metric=str(result.get("metric")))
+    obs.gauge("bench_vs_baseline").set(
+        float(result.get("vs_baseline") or 0.0),
+        metric=str(result.get("metric")))
+    with open(path + ".prom", "w") as f:
+        f.write(obs.render_prometheus())
+    check = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "check_metrics_log.py")
+    proc = subprocess.run(
+        [sys.executable, check, path, "--require-steps", str(steps)],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench telemetry failed validation: {proc.stderr.strip()}")
+    return path
+
+
 def _probe_backend(timeout: float) -> str | None:
     """Try TPU backend init in a SUBPROCESS with a hard timeout.
 
@@ -186,6 +254,8 @@ def run_bench_resnet(dev):
         "image_size": hw,
         "flops_per_step": flops_per_step,
         "loss": round(final_loss, 4),
+        "_telemetry": {"steps": steps, "dt": dt,
+                       "examples_per_step": batch_size},
     }
 
 
@@ -262,6 +332,9 @@ def run_bench(dev):
         "seq_len": seq,
         "params": n_params,
         "loss": round(final_loss, 4),
+        "_telemetry": {"steps": steps, "dt": dt,
+                       "examples_per_step": batch_size,
+                       "tokens_per_step": tokens_per_step},
     }
 
 
@@ -389,6 +462,9 @@ def run_bench_transformer(dev):
         "rows_per_batch": rows,
         "src_len": src_len,
         "loss": round(loss, 4),
+        "_telemetry": {"steps": steps, "dt": step_s * steps,
+                       "examples_per_step": rows,
+                       "tokens_per_step": packed_tps * step_s},
     }
 
 
@@ -466,6 +542,9 @@ def run_bench_deepfm(dev):
         "fields": fields,
         "embed_dim": dim,
         "loss": round(loss, 4),
+        "_telemetry": {"steps": n_batches,
+                       "dt": batch * n_batches / max(eps_on, 1e-9),
+                       "examples_per_step": batch},
     }
 
 
@@ -493,11 +572,16 @@ def main():
         if which not in _BENCHES:
             raise ValueError(f"unknown --model {which!r} "
                              f"(expected {'|'.join(_BENCHES)})")
+        from paddle_tpu import observability as obs
+        obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
         result = _BENCHES[which][0](dev)
-        if degraded:
+        if degraded:  # zero BEFORE telemetry so JSONL/.prom agree with stdout
             result["error"] = degraded
             result["vs_baseline"] = 0.0
+        log_path = write_bench_telemetry(result)
+        if log_path:
+            result["metrics_log"] = log_path
     except Exception as e:  # fail-soft: always emit a parseable line, rc=0
         fn, metric, unit = _BENCHES.get(which, _BENCHES["bert"])
         result = {
@@ -507,6 +591,7 @@ def main():
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
         }
+    result.pop("_telemetry", None)  # never leak internals to the JSON line
     print(json.dumps(result))
 
 

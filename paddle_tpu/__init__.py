@@ -9,8 +9,8 @@ runtime services (data feeding, inference serving) are native C++.
 from paddle_tpu.version import __version__
 
 from paddle_tpu import (amp, config, core, data, debug, fleet, inference,
-                        io, metrics, models, nn, ops, optimizer, parallel,
-                        profiler, train, trainer)
+                        io, metrics, models, nn, observability, ops,
+                        optimizer, parallel, profiler, train, trainer)
 from paddle_tpu.trainer import Trainer
 from paddle_tpu.config import global_config, set_flags
 from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
@@ -19,8 +19,8 @@ from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
 
 __all__ = [
     "__version__", "amp", "config", "core", "data", "debug", "fleet",
-    "inference", "io", "metrics", "models", "nn", "ops", "optimizer",
-    "parallel", "profiler", "train", "trainer", "Trainer",
+    "inference", "io", "metrics", "models", "nn", "observability", "ops",
+    "optimizer", "parallel", "profiler", "train", "trainer", "Trainer",
     "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
     "CompiledProgram", "Executor", "Program",
     "build_eval_step", "build_train_step", "make_train_state",

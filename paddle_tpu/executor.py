@@ -20,10 +20,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Optional, Sequence
 
+import time
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu import observability
 from paddle_tpu.core import mesh as mesh_lib
 
 
@@ -148,17 +151,26 @@ class Executor:
         """Run one step. ``fetch_list`` selects keys out of a dict result
         (fluid fetch parity); None returns everything."""
         feed = feed or {}
+        reg = observability.default()
         if isinstance(program, Program):
             # Keyed by id but the cache holds a strong ref to the Program, so
             # an address can't be recycled while its entry is alive.
             key = id(program)
             if key not in self._cache:
                 self._cache[key] = (program, program.compile(self.mesh))
+                reg.counter("executor_program_compiles_total",
+                            "Program cache misses (new jit wrappers)"
+                            ).inc(name=program.name)
             cached_prog, compiled = self._cache[key]
             assert cached_prog is program
         else:
             compiled = program
+        t0 = time.perf_counter()
         out = compiled(state, **feed)
+        reg.counter("executor_run_calls_total").inc()
+        reg.histogram("executor_run_seconds",
+                      "Executor.run dispatch wall time").observe(
+                          time.perf_counter() - t0)
         if isinstance(out, tuple) and len(out) == 2:
             state, fetches = out
         else:
@@ -171,7 +183,7 @@ class Executor:
 
     def train_from_dataset(self, program, dataset, state, *,
                            batch_size=64, epochs=1, feed_builder=None,
-                           fetch_handler=None):
+                           fetch_handler=None, run_log=None):
         """Dataset-path training (fluid executor.py:1101
         ``train_from_dataset`` → ``Executor::RunFromDataset``,
         executor.cc:168): run ``program`` over every batch of ``dataset``
@@ -180,20 +192,38 @@ class Executor:
         a reader creator) streams host batches into one jitted program —
         XLA owns the device parallelism. ``feed_builder(samples) -> feed``
         adapts raw reader samples; ``fetch_handler(step, fetches)``
-        observes results (PrintFetchVars parity). Returns (state, last
-        fetches)."""
+        observes results (PrintFetchVars parity). ``run_log=`` writes one
+        JSONL telemetry record per step (observability.runlog schema).
+        Returns (state, last fetches)."""
         fetches = None
         step_i = 0
-        for _ in range(epochs):
-            # training drops the ragged tail (a different batch shape
-            # would trigger a recompile for one step per epoch)
-            for batch in _dataset_batches(dataset, batch_size,
-                                          feed_builder, drop_last=True):
-                state, fetches = self.run(program, state, feed=batch,
-                                          return_numpy=False)
-                if fetch_handler is not None:
-                    fetch_handler(step_i, fetches)
-                step_i += 1
+        tel = observability.StepTelemetry(
+            "executor_dataset", run_log=run_log,
+            run_meta={"batch_size": batch_size, "epochs": epochs})
+        try:
+            for epoch in range(epochs):
+                # training drops the ragged tail (a different batch shape
+                # would trigger a recompile for one step per epoch)
+                it = iter(_dataset_batches(dataset, batch_size,
+                                           feed_builder, drop_last=True))
+                while True:
+                    t_fetch = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    tel.data_wait(time.perf_counter() - t_fetch)
+                    t_step = time.perf_counter()
+                    state, fetches = self.run(program, state, feed=batch,
+                                              return_numpy=False)
+                    step_i += 1
+                    tel.step(step_i, feeds=batch,
+                             step_time_s=time.perf_counter() - t_step,
+                             examples=batch_size, epoch=epoch)
+                    if fetch_handler is not None:
+                        fetch_handler(step_i - 1, fetches)
+        finally:
+            tel.close()
         return state, fetches
 
     def infer_from_dataset(self, program, dataset, state, *,

@@ -66,6 +66,11 @@ def init(role: Optional[RoleMaker] = None) -> RoleMaker:
             num_processes=role.worker_num,
             process_id=role.worker_index)
         _INITIALIZED = True
+    # identity gauges: every host's exposition shows who it is, so a
+    # scraper can join per-host series (observability.aggregate's view)
+    from paddle_tpu import observability as _obs
+    _obs.gauge("fleet_worker_index").set(role.worker_index)
+    _obs.gauge("fleet_worker_num").set(role.worker_num)
     return role
 
 
@@ -109,12 +114,16 @@ class HeartbeatMonitor:
         self._log = log_fn
 
         def watch():
+            from paddle_tpu import observability as _obs
             while not self._stop.wait(check_every_s):
                 idle = _time.monotonic() - self._last
+                _obs.gauge("fleet_heartbeat_idle_seconds",
+                           "seconds since the last step beat").set(idle)
                 if idle > self.timeout_s:
                     msg = (f"[heartbeat] no progress for {idle:.0f}s "
                            f"(last step {self._step})")
                     self._log(msg)
+                    _obs.counter("fleet_heartbeat_stalls_total").inc()
                     if self._on_stall is not None:
                         self._on_stall(self._step, idle)
 
@@ -126,6 +135,8 @@ class HeartbeatMonitor:
 
         self._last = _time.monotonic()
         self._step = step
+        from paddle_tpu import observability as _obs
+        _obs.gauge("fleet_last_step", "latest step a beat reported").set(step)
 
     def stop(self):
         self._stop.set()

@@ -228,8 +228,21 @@ class Predictor:
         with open(os.path.join(path, _META_FILE)) as f:
             self.meta = json.load(f)
         self.input_names = self.meta["input_names"]
+        from paddle_tpu import observability as _obs
+        self._reg = _obs.default()
+        self._reg.counter("inference_predictors_total",
+                          "Predictor instances loaded").inc()
 
     def run(self, *inputs, feed: Optional[Dict[str, Any]] = None):
+        import time as _time
         if feed is not None:
             inputs = tuple(feed[name] for name in self.input_names)
-        return self._exported.call(self._params, *inputs)
+        t0 = _time.perf_counter()
+        out = self._exported.call(self._params, *inputs)
+        # serving observability: request count + dispatch latency, per
+        # exported artifact — the AnalysisPredictor-side QPS counters
+        self._reg.counter("inference_requests_total").inc()
+        self._reg.histogram("inference_latency_seconds",
+                            "Predictor.run dispatch latency").observe(
+                                _time.perf_counter() - t0)
+        return out

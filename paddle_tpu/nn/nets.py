@@ -113,6 +113,8 @@ class ImgConvGroup(Layer):
         self.pool = Pool2D(pool_size, pool_stride, pool_type=pool_type)
 
     def forward(self, params, x, *, training=False, dropout_key=None):
+        import jax
+
         h = x
         for i in range(self.n):
             h = getattr(self, f"conv{i}")(params[f"conv{i}"], h)
@@ -122,8 +124,13 @@ class ImgConvGroup(Layer):
                                             training=training)
                 h = self.act(h)
                 if abs(self.drop[i]) > 1e-5:
+                    # per-layer key: reusing one key across sublayers
+                    # correlates their masks (same positions drop at
+                    # equal rates), silently weakening regularization
+                    layer_key = (jax.random.fold_in(dropout_key, i)
+                                 if dropout_key is not None else None)
                     h = getattr(self, f"dropout{i}")(
-                        None, h, key=dropout_key, training=training)
+                        None, h, key=layer_key, training=training)
             else:
                 h = self.act(h)
         return self.pool(None, h)
@@ -139,6 +146,7 @@ class SequenceConvPool(Layer):
                  act="sigmoid", pool_type="max", bias=True):
         super().__init__()
         from paddle_tpu.nn import initializer as I
+        self.filter_size = filter_size
         self.filter = self.create_parameter(
             "filter", (filter_size * input_dim, num_filters),
             initializer=I.xavier_uniform())
@@ -150,7 +158,11 @@ class SequenceConvPool(Layer):
         self.pool_type = pool_type
 
     def forward(self, params, x, lengths):
-        h = S.sequence_conv(x, lengths, params["filter"])
+        # center the context window on t for ANY filter size (reference
+        # sequence_conv default: context_start = -ctx_len//2; the old
+        # hardcoded -1 mis-aligned even filter sizes by one step)
+        h = S.sequence_conv(x, lengths, params["filter"],
+                            context_start=-(self.filter_size // 2))
         if self.has_bias:
             h = h + params["bias"]
         h = self.act(h)

@@ -1,0 +1,77 @@
+"""Runtime telemetry subsystem: structured run metrics, recompilation /
+step tracing, and cross-host aggregation.
+
+Three parts (ISSUE 1 / TensorFlow-paper-style first-class telemetry):
+
+1. **Metrics registry** (`registry.py`): process-wide named Counter /
+   Gauge / Histogram with labels; Prometheus text exposition
+   (:func:`render_prometheus`); flat :func:`snapshot` for logs.
+2. **Run log + hot-path instrumentation** (`runlog.py`, `telemetry.py`,
+   `recompile.py`): crash-safe JSONL (one record per step), the
+   :class:`StepTelemetry` driver wired into ``Trainer.fit`` /
+   ``Executor.train_from_dataset``, a :class:`RecompileDetector` over
+   ``jax.monitoring`` compile events, and per-device memory gauges.
+3. **Cross-host aggregation** (`aggregate.py`): :func:`aggregate`
+   all-gathers scalars so host 0 sees min/max/mean per-host skew.
+
+``profiler.record_event`` spans feed the same registry, so one
+:func:`report` call dumps a unified summary.
+"""
+
+from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                               MetricsRegistry, counter,
+                                               default, gauge, histogram)
+from paddle_tpu.observability.runlog import (RunLogWriter, read_run_log,
+                                             validate_record,
+                                             validate_run_log)
+from paddle_tpu.observability.recompile import (RecompileDetector,
+                                                compile_count,
+                                                install_compile_listener,
+                                                shape_signature)
+from paddle_tpu.observability.aggregate import aggregate, format_aggregate
+from paddle_tpu.observability.telemetry import (StepTelemetry,
+                                                device_memory_stats,
+                                                record_memory_gauges)
+from paddle_tpu.observability.report import SPAN_METRIC, report
+
+
+def render_prometheus(reg: MetricsRegistry = None) -> str:
+    """Prometheus text-format exposition of ``reg`` (default registry)."""
+    return (reg or default()).render_prometheus()
+
+
+def snapshot(reg: MetricsRegistry = None) -> dict:
+    """Flat scalar snapshot of ``reg`` (default registry)."""
+    return (reg or default()).snapshot()
+
+
+_SPAN_NAME_CAP = 256
+
+
+def observe_span(name: str, seconds: float,
+                 reg: MetricsRegistry = None):
+    """Feed one profiler ``record_event`` span into the registry (the
+    unified-summary bridge; called by ``paddle_tpu.profiler``).
+
+    Cardinality-bounded: record_event names can be dynamic (per-shard,
+    per-request), and the registry keeps one series per name for the
+    process lifetime — beyond ``_SPAN_NAME_CAP`` distinct names, new
+    ones lump into the ``__other__`` series instead of growing memory
+    without bound."""
+    h = (reg or default()).histogram(
+        SPAN_METRIC, "host record_event span durations")
+    seen = h.labels_seen()
+    if len(seen) >= _SPAN_NAME_CAP and (("name", str(name)),) not in seen:
+        name = "__other__"
+    h.observe(seconds, name=name)
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter",
+    "default", "gauge", "histogram", "RunLogWriter", "read_run_log",
+    "validate_record", "validate_run_log", "RecompileDetector",
+    "compile_count", "install_compile_listener", "shape_signature",
+    "aggregate", "format_aggregate", "StepTelemetry",
+    "device_memory_stats", "record_memory_gauges", "SPAN_METRIC",
+    "report", "render_prometheus", "snapshot", "observe_span",
+]

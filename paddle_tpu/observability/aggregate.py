@@ -1,0 +1,60 @@
+"""Cross-host metric aggregation: make per-host skew visible from host 0.
+
+In a multi-process (fleet) run every host sees only its own step time /
+throughput / data-wait; a single slow host (straggler input pipeline,
+thermal throttling, a busy NUMA node) silently drags the whole SPMD
+program because the collectives rate-limit to the slowest participant.
+``aggregate()`` all-gathers a dict of scalars over the JAX coordination
+fabric and returns min/max/mean (+argmin/argmax host index) per key, so
+host 0's log line shows the skew directly.
+
+Single-process runs short-circuit to a pure-Python no-op (min == max ==
+mean == the local value) — no device work, usable in unit tests and
+CPU smoke runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+
+def aggregate(values: Mapping[str, float]) -> Dict[str, Dict[str, float]]:
+    """All-gather ``{name: scalar}`` across hosts -> per-name stats.
+
+    Every participating host MUST call with the same key set (keys are
+    sorted into a dense vector before the collective); the return value
+    is identical on every host: ``{name: {min, max, mean, argmin,
+    argmax}}`` where argmin/argmax are host (process) indices.
+    """
+    keys = sorted(values)
+    local = np.asarray([float(values[k]) for k in keys], np.float64)
+    import jax
+    n = jax.process_count()
+    if n == 1 or not keys:
+        rows = local[None, :]
+    else:
+        from jax.experimental import multihost_utils
+        rows = np.asarray(multihost_utils.process_allgather(local))
+        if rows.shape != (n, len(keys)):       # defensive: API drift
+            rows = rows.reshape(n, len(keys))
+    out: Dict[str, Dict[str, float]] = {}
+    for j, k in enumerate(keys):
+        col = rows[:, j]
+        out[k] = {
+            "min": float(col.min()), "max": float(col.max()),
+            "mean": float(col.mean()),
+            "argmin": int(col.argmin()), "argmax": int(col.argmax()),
+        }
+    return out
+
+
+def format_aggregate(stats: Mapping[str, Dict[str, float]]) -> str:
+    """One human line per metric: ``name min/mean/max (slowest host)``."""
+    parts = []
+    for k in sorted(stats):
+        s = stats[k]
+        parts.append(f"{k} {s['min']:.4g}/{s['mean']:.4g}/{s['max']:.4g}"
+                     f" (host{int(s['argmax'])} high)")
+    return "[hosts] " + "  ".join(parts)

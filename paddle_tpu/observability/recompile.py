@@ -1,0 +1,142 @@
+"""Recompilation detection: surface silent mid-training retraces.
+
+The dominant TPU-side performance failure mode is a jitted step silently
+recompiling every step (shape drift in the input pipeline, a weak-type
+flip, a Python-hashable static arg changing). XLA gives no hot-path
+signal — the step just takes seconds instead of milliseconds — so this
+module listens to ``jax.monitoring``'s compile-duration events (emitted
+once per backend compile, cache hits excluded), keeps a process-wide
+count, and lets the Trainer snapshot it per step: a count increase after
+warmup is a retrace, logged as a structured warning with the function
+name and the offending batch's arg-shape signature.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from paddle_tpu.observability import registry as _registry
+
+# any of these firing == one backend compile happened in-process
+_COMPILE_EVENTS = ("/jax/core/compile/backend_compile_duration",)
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _on_duration(event: str, duration: float, **kw):
+    global _count
+    if event in _COMPILE_EVENTS:
+        with _lock:
+            _count += 1
+        _registry.counter(
+            "jax_compiles_total",
+            "backend compiles observed via jax.monitoring").inc()
+        _registry.histogram(
+            "jax_compile_seconds",
+            "backend compile wall time").observe(duration)
+
+
+def install_compile_listener():
+    """Idempotently hook jax.monitoring's compile-duration stream.
+
+    Degrades gracefully: if this jax has no (or a renamed) monitoring
+    API, detection stays silently off (compile_count() == 0 forever)
+    rather than taking down the training loop — telemetry must never
+    kill a run. One attempt per process either way."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True  # one attempt per process, success or not
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            f"[observability] jax.monitoring unavailable ({e}); "
+            "recompile detection disabled", RuntimeWarning)
+
+
+def compile_count() -> int:
+    """Backend compiles observed in this process since the listener was
+    installed (0 before :func:`install_compile_listener`)."""
+    with _lock:
+        return _count
+
+
+def shape_signature(feeds: Optional[Dict[str, Any]]) -> str:
+    """Stable ``name:dtype[shape]`` signature of a feed dict — the
+    retrace warning's 'what changed' half."""
+    if not feeds:
+        return "<no feeds>"
+
+    def one(v):
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is None:
+            return f"{type(v).__name__}"
+        ds = getattr(dtype, "name", str(dtype))
+        return f"{ds}[{','.join(map(str, shape))}]"
+
+    return " ".join(f"{k}:{one(v)}" for k, v in sorted(feeds.items()))
+
+
+class RecompileDetector:
+    """Per-callsite retrace watcher around the global compile counter.
+
+    Protocol (what Trainer.fit does):
+      det = RecompileDetector("train_step")
+      ... run step ...
+      new = det.check(step=i, feeds=batch)   # compiles since last check
+    The first ``warmup`` checks that see compiles are expected (initial
+    trace) and counted but not warned about; any later increase fires a
+    structured warning via ``log_fn`` and bumps the
+    ``<name>_recompiles_total`` counter.
+    """
+
+    def __init__(self, name: str = "step",
+                 *, warmup: int = 1,
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 log_fn: Callable[[str], None] = None):
+        install_compile_listener()
+        self.name = name
+        self.warmup = warmup
+        self._reg = registry or _registry.default()
+        self._log = log_fn if log_fn is not None else _warn
+        self._baseline = compile_count()
+        self._last = self._baseline
+        self._checks = 0
+        self.compiles_cum = 0     # compiles since construction
+        self.recompiles = 0       # compiles after warmup (true retraces)
+
+    def check(self, *, step: Optional[int] = None,
+              feeds: Optional[Dict[str, Any]] = None) -> int:
+        """Call once per step AFTER the step ran. Returns the number of
+        new compiles observed since the previous check."""
+        now = compile_count()
+        new = now - self._last
+        self._last = now
+        self._checks += 1
+        self.compiles_cum = now - self._baseline
+        if new and self._checks > self.warmup:
+            self.recompiles += new
+            self._reg.counter(
+                f"{self.name}_recompiles_total",
+                "post-warmup retraces (shape/dtype drift)").inc(new)
+            at = f" step={step}" if step is not None else ""
+            self._log(
+                f"[observability] RECOMPILATION: fn={self.name}{at} "
+                f"new_compiles={new} total_retraces={self.recompiles} — "
+                f"arg signature: {shape_signature(feeds)} (a mid-training "
+                "retrace usually means input shape/dtype drift; pad or "
+                "bucket the batch)")
+        return new
+
+
+def _warn(msg: str):
+    import warnings
+    warnings.warn(msg, RuntimeWarning, stacklevel=3)

@@ -1,0 +1,290 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with labels.
+
+Reference mapping: the reference framework's runtime stats are scattered
+(profiler summaries, per-pass VLOG counters, pserver-side monitor tables);
+TensorFlow's system paper treats run metrics as a first-class subsystem.
+Here ONE registry backs every consumer: the hot-path instrumentation
+(trainer/executor/inference), the JSONL run log (runlog.py), Prometheus
+text exposition for external scrapers, and ``observability.report()``.
+
+Design: plain host-side Python (no jax imports — safe to use inside data
+threads and before backend init), a single lock per registry, and label
+sets keyed by sorted ``(key, value)`` tuples so ``counter.inc(host=0)``
+and ``counter.inc(host=1)`` are independent series of one metric.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape_label(v: str) -> str:
+    # Prometheus exposition: backslash, double-quote and newline must be
+    # escaped inside label values or the whole dump is unparseable
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    return ("{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+            + "}")
+
+
+class _Metric:
+    """Shared series bookkeeping; subclasses define the per-series cell."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not name or any(c in name for c in " \t\n{}\","):
+            raise ValueError(f"bad metric name {name!r}")
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[LabelKey, object] = {}
+
+    def _cell(self, labels: Dict[str, object]):
+        key = _label_key(labels)
+        with self._lock:
+            cell = self._series.get(key)
+            if cell is None:
+                cell = self._series[key] = self._new_cell()
+            return cell
+
+    def labels_seen(self) -> List[LabelKey]:
+        with self._lock:
+            return list(self._series)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (reference: per-op run counters)."""
+
+    kind = "counter"
+
+    def _new_cell(self):
+        return [0.0]
+
+    def inc(self, n: float = 1.0, **labels) -> "Counter":
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        cell = self._cell(labels)
+        with self._lock:
+            cell[0] += n
+        return self
+
+    def value(self, **labels) -> float:
+        return self._cell(labels)[0]
+
+
+class Gauge(_Metric):
+    """Point-in-time value (memory bytes, queue depth, worker id)."""
+
+    kind = "gauge"
+
+    def _new_cell(self):
+        return [0.0]
+
+    def set(self, v: float, **labels) -> "Gauge":
+        cell = self._cell(labels)
+        with self._lock:
+            cell[0] = float(v)
+        return self
+
+    def inc(self, n: float = 1.0, **labels) -> "Gauge":
+        cell = self._cell(labels)
+        with self._lock:
+            cell[0] += n
+        return self
+
+    def value(self, **labels) -> float:
+        return self._cell(labels)[0]
+
+
+# default buckets suit step/span latencies (seconds): 100us .. 100s
+DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5,
+                   1.0, 5.0, 10.0, 50.0, 100.0)
+
+
+class _HistCell:
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self, nbuckets: int):
+        self.counts = [0] * (nbuckets + 1)  # +1 = +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram + running min/max/sum/count.
+
+    min/max are not Prometheus-native but back ``aggregate()``'s cross-
+    host skew view and the report() table."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+
+    def _new_cell(self):
+        return _HistCell(len(self.buckets))
+
+    def observe(self, v: float, **labels) -> "Histogram":
+        v = float(v)
+        cell = self._cell(labels)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            cell.counts[i] += 1
+            cell.count += 1
+            cell.sum += v
+            cell.min = min(cell.min, v)
+            cell.max = max(cell.max, v)
+        return self
+
+    def summary(self, **labels) -> Dict[str, float]:
+        cell = self._cell(labels)
+        with self._lock:
+            if not cell.count:
+                return {"count": 0, "sum": 0.0, "mean": 0.0,
+                        "min": 0.0, "max": 0.0}
+            return {"count": cell.count, "sum": cell.sum,
+                    "mean": cell.sum / cell.count,
+                    "min": cell.min, "max": cell.max}
+
+
+class MetricsRegistry:
+    """Name -> metric table; the process-wide instance is ``default()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    # -- views -------------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {'name{label="v"}': scalar} view — what the JSONL log and
+        aggregate() consume. Histograms flatten to _count/_sum/_min/_max/
+        _mean suffixes."""
+        out: Dict[str, float] = {}
+        for m in self.metrics():
+            for key in m.labels_seen():
+                lab = _fmt_labels(key)
+                if isinstance(m, Histogram):
+                    s = m.summary(**dict(key))
+                    for suffix in ("count", "sum", "mean", "min", "max"):
+                        out[f"{m.name}_{suffix}{lab}"] = s[suffix]
+                else:
+                    out[f"{m.name}{lab}"] = m.value(**dict(key))
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4): the contract that
+        lets bench.py and an external scraper read the same numbers."""
+        lines: List[str] = []
+        for m in self.metrics():
+            keys = m.labels_seen()
+            if not keys:
+                continue
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key in keys:
+                labels = dict(key)
+                if isinstance(m, Histogram):
+                    cell = m._cell(labels)
+                    cum = 0
+                    for b, c in zip(m.buckets, cell.counts):
+                        cum += c
+                        lab = _fmt_labels(key + (("le", _fmt_le(b)),))
+                        lines.append(f"{m.name}_bucket{lab} {cum}")
+                    cum += cell.counts[-1]
+                    lab = _fmt_labels(key + (("le", "+Inf"),))
+                    lines.append(f"{m.name}_bucket{lab} {cum}")
+                    lab = _fmt_labels(key)
+                    lines.append(f"{m.name}_sum{lab} {_fmt_num(cell.sum)}")
+                    lines.append(f"{m.name}_count{lab} {cell.count}")
+                else:
+                    lab = _fmt_labels(key)
+                    lines.append(
+                        f"{m.name}{lab} {_fmt_num(m.value(**labels))}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_le(b: float) -> str:
+    return repr(float(b))
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default() -> MetricsRegistry:
+    return _DEFAULT
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _DEFAULT.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _DEFAULT.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _DEFAULT.histogram(name, help, buckets=buckets)

@@ -1,0 +1,60 @@
+"""Unified run summary: one ``report()`` call renders everything the
+registry saw — counters, gauges, histograms, and the profiler's
+``record_event`` spans (which feed the same registry) — as one text
+block. The reference's sorted profiler summary, generalized to the whole
+telemetry surface.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.observability import registry as _registry
+from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                               _fmt_labels)
+
+SPAN_METRIC = "record_event_span_seconds"
+
+
+def report(reg: Optional[_registry.MetricsRegistry] = None) -> str:
+    """Render the unified observability summary."""
+    reg = reg or _registry.default()
+    scalars: List[str] = []
+    hists: List[str] = []
+    spans: List[tuple] = []
+    for m in reg.metrics():
+        for key in m.labels_seen():
+            labels = dict(key)
+            if isinstance(m, Histogram):
+                s = m.summary(**labels)
+                if not s["count"]:
+                    continue
+                if m.name == SPAN_METRIC:
+                    spans.append((labels.get("name", "?"), s))
+                    continue
+                hists.append(
+                    f"{m.name}{_fmt_labels(key)}  count={s['count']} "
+                    f"mean={s['mean']:.6g} min={s['min']:.6g} "
+                    f"max={s['max']:.6g} sum={s['sum']:.6g}")
+            else:
+                kind = "c" if isinstance(m, Counter) else "g"
+                scalars.append(f"{m.name}{_fmt_labels(key)} "
+                               f"[{kind}] {m.value(**labels):.6g}")
+    lines = ["== paddle_tpu observability report =="]
+    if scalars:
+        lines.append("-- counters / gauges --")
+        lines.extend(sorted(scalars))
+    if hists:
+        lines.append("-- histograms --")
+        lines.extend(sorted(hists))
+    if spans:
+        lines.append("-- record_event spans --")
+        lines.append(f"{'Event':<32}{'Calls':>8}{'Total(s)':>12}"
+                     f"{'Avg(ms)':>12}{'Max(ms)':>12}")
+        for name, s in sorted(spans, key=lambda kv: -kv[1]["sum"]):
+            lines.append(
+                f"{name:<32}{s['count']:>8}{s['sum']:>12.4f}"
+                f"{1e3 * s['mean']:>12.3f}{1e3 * s['max']:>12.3f}")
+    if len(lines) == 1:
+        lines.append("(no metrics recorded)")
+    return "\n".join(lines)

@@ -1,0 +1,206 @@
+"""Hot-path step telemetry: the glue between a training/eval loop and
+the registry + JSONL log + recompile detector + cross-host view.
+
+``StepTelemetry`` is what Trainer.fit (and Executor.train_from_dataset)
+actually drive: one object owning the per-step bookkeeping so the loops
+stay one-call-per-step. It is deliberately tolerant — telemetry must
+never take down a training run, so device-memory polling and cross-host
+aggregation are individually guarded.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from paddle_tpu.observability import aggregate as _agg
+from paddle_tpu.observability import recompile as _recompile
+from paddle_tpu.observability import registry as _registry
+from paddle_tpu.observability import runlog as _runlog
+
+
+def device_memory_stats() -> Dict[str, Dict[str, float]]:
+    """Per-local-device memory stats where the backend exposes them
+    (PJRT ``memory_stats``; TPU and recent CPU plugins do, some don't).
+    Returns {} when unavailable — callers treat memory as optional."""
+    out: Dict[str, Dict[str, float]] = {}
+    try:
+        import jax
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            keep = {k: float(v) for k, v in stats.items()
+                    if k in ("bytes_in_use", "peak_bytes_in_use",
+                             "bytes_limit", "largest_alloc_size")}
+            if keep:
+                out[f"{d.platform}:{d.id}"] = keep
+    except Exception:
+        return {}
+    return out
+
+
+def record_memory_gauges(reg: Optional[_registry.MetricsRegistry] = None
+                         ) -> Dict[str, Dict[str, float]]:
+    """Poll device memory into ``device_memory_bytes`` gauges; returns
+    the raw stats dict (for the JSONL record)."""
+    reg = reg or _registry.default()
+    stats = device_memory_stats()
+    if stats:
+        g = reg.gauge("device_memory_bytes",
+                      "per-device PJRT memory stats")
+        for dev, kv in stats.items():
+            for stat, v in kv.items():
+                g.set(v, device=dev, stat=stat)
+    return stats
+
+
+class StepTelemetry:
+    """Per-step metrics for one training run.
+
+    Owns: step-time/throughput histograms + counters in ``registry``,
+    an optional JSONL :class:`~paddle_tpu.observability.runlog.RunLogWriter`,
+    a :class:`~paddle_tpu.observability.recompile.RecompileDetector`, and
+    (multi-host) periodic min/max/mean aggregation printed via ``log_fn``.
+
+    Loop protocol::
+
+        tel = StepTelemetry("train", run_log=path)
+        for ...:
+            t0 = perf(); batch = next(it); tel.data_wait(perf() - t0)
+            t1 = perf(); state, m = step(state, **batch)
+            tel.step(gstep, feeds=batch, step_time_s=perf() - t1,
+                     examples=bsz, metrics=m, epoch=e)
+        tel.close()
+
+    Step wall time is dispatch-clocked (JAX async dispatch): in steady
+    state the device back-pressures the host loop so per-step times are
+    honest; the first post-compile steps can look fast.
+    """
+
+    def __init__(self, name: str = "train", *,
+                 run_log: Optional[str] = None,
+                 run_meta: Optional[Dict[str, Any]] = None,
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 log_fn: Callable[[str], None] = print,
+                 memory_every: int = 50,
+                 aggregate_every: int = 0,
+                 detect_recompiles: bool = True):
+        self.name = name
+        self.reg = registry or _registry.default()
+        self.log_fn = log_fn
+        self.memory_every = memory_every
+        self.aggregate_every = aggregate_every
+        self.writer = None
+        if run_log:
+            self.writer = _runlog.RunLogWriter(
+                run_log, meta=dict(run_meta or {}, name=name))
+        self.detector = (_recompile.RecompileDetector(
+            f"{name}_step", log_fn=log_fn) if detect_recompiles else None)
+        self._wait_s = 0.0
+        self._steps = 0
+        self._h_step = self.reg.histogram(
+            f"{name}_step_seconds", "per-step wall time")
+        self._h_wait = self.reg.histogram(
+            f"{name}_data_wait_seconds", "host blocked on the input "
+            "pipeline per step")
+        self._c_steps = self.reg.counter(f"{name}_steps_total")
+        self._c_examples = self.reg.counter(f"{name}_examples_total")
+        self._c_tokens = self.reg.counter(f"{name}_tokens_total")
+        self._g_eps = self.reg.gauge(f"{name}_examples_per_sec",
+                                     "throughput of the latest step")
+
+    # -- per-step protocol -------------------------------------------------
+    def data_wait(self, seconds: float):
+        """Host time spent blocked fetching the next batch."""
+        self._wait_s = max(0.0, float(seconds))
+        self._h_wait.observe(self._wait_s)
+
+    def step(self, step: int, *, step_time_s: float, examples: int,
+             feeds: Optional[Dict[str, Any]] = None,
+             tokens: Optional[int] = None,
+             metrics: Optional[Dict[str, float]] = None,
+             epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Record one completed step; returns the JSONL record (also
+        written to the run log when one is attached)."""
+        step_time_s = max(float(step_time_s), 1e-9)
+        self._steps += 1
+        self._h_step.observe(step_time_s)
+        self._c_steps.inc()
+        self._c_examples.inc(examples)
+        eps = examples / step_time_s
+        self._g_eps.set(eps)
+        if self.detector is not None:
+            self.detector.check(step=step, feeds=feeds)
+        # the data-wait vs compute split is (data_wait_s, step_time_s):
+        # fetch blocking is OUTSIDE the step timer, so step_time_s IS the
+        # compute (dispatch) side — no separate compute_s field
+        rec: Dict[str, Any] = {
+            "kind": "step", "step": int(step),
+            "step_time_s": round(step_time_s, 6),
+            "examples_per_sec": round(eps, 3),
+            "data_wait_s": round(self._wait_s, 6),
+        }
+        if tokens:
+            self._c_tokens.inc(tokens)
+            rec["tokens_per_sec"] = round(tokens / step_time_s, 3)
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
+        if self.detector is not None:
+            rec["recompiles"] = self.detector.recompiles
+            rec["compiles_cum"] = self.detector.compiles_cum
+        if metrics:
+            try:
+                rec["metrics"] = {k: float(v) for k, v in metrics.items()}
+            except Exception:
+                pass  # non-scalar fetches: skip rather than sync/crash
+        try:
+            import jax
+            if jax.process_count() > 1:
+                rec["host"] = jax.process_index()
+        except Exception:
+            pass
+        if self.memory_every and self._steps % self.memory_every == 0:
+            mem = record_memory_gauges(self.reg)
+            if mem:
+                rec["memory"] = mem
+        self._wait_s = 0.0
+        if self.writer is not None:
+            self.writer.write(rec)
+        if (self.aggregate_every
+                and self._steps % self.aggregate_every == 0):
+            self.aggregate_line(rec)
+        return rec
+
+    # -- cross-host --------------------------------------------------------
+    def aggregate_line(self, rec: Dict[str, Any]):
+        """Multi-host: all-gather the step's headline numbers and print
+        the min/mean/max skew line from host 0. Single-host: no-op."""
+        try:
+            import jax
+            if jax.process_count() == 1:
+                return
+            stats = _agg.aggregate({
+                "step_time_s": rec["step_time_s"],
+                "examples_per_sec": rec["examples_per_sec"],
+                "data_wait_s": rec.get("data_wait_s", 0.0),
+            })
+            if jax.process_index() == 0:
+                self.log_fn(f"[observability] step {rec['step']} "
+                            + _agg.format_aggregate(stats))
+        except Exception as e:  # telemetry must never kill the run
+            self.log_fn(f"[observability] aggregate failed: {e}")
+
+    def close(self, summary: Optional[Dict[str, Any]] = None):
+        if self.writer is not None:
+            rec = {"kind": "summary", "steps": self._steps}
+            s = self._h_step.summary()
+            rec["step_time_mean_s"] = round(s["mean"], 6)
+            rec["step_time_max_s"] = round(s["max"], 6)
+            if self.detector is not None:
+                rec["recompiles"] = self.detector.recompiles
+                rec["compiles_cum"] = self.detector.compiles_cum
+            if summary:
+                rec.update(summary)
+            self.writer.write(rec)
+            self.writer.close()

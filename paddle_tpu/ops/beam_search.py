@@ -59,7 +59,10 @@ def beam_search_step(logp, scores, done, *, eos_id: int, pad_id: int = 0,
     Finished beams only ever continue with ``pad_id`` at unchanged score.
     """
     b, k, v = logp.shape
-    k_out = beam_size or k
+    # `or` would silently treat an explicit beam_size=0 as "default to k"
+    k_out = k if beam_size is None else beam_size
+    if k_out < 1:
+        raise ValueError(f"beam_size must be >= 1, got {k_out}")
     if k_out > k:
         raise ValueError(f"cannot grow beams: {k_out} > {k}")
     logp = logp.astype(jnp.float32)

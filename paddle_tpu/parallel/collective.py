@@ -30,8 +30,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 def shard_map(f, *, mesh, in_specs, out_specs):
     # check_vma=False: these wrappers take logically-replicated inputs whose
     # axis-invariance the varying-axes checker cannot prove.
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from paddle_tpu.core.compat import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs,
+               out_specs=out_specs, check_vma=False)
 
 from paddle_tpu.core import mesh as mesh_lib
 

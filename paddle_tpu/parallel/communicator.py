@@ -30,6 +30,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from paddle_tpu.core.compat import axis_size as _axis_size
 import numpy as np
 
 
@@ -162,7 +163,7 @@ def geo_sgd_sync(stacked_params, anchor, *, participants=None, axis="dp",
         participants = jnp.ones((n_workers,), bool)
 
     def body(stacked, anchor, mask):
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         m = mask[0].astype(jnp.float32)       # this worker's flag
 
         def merge(p, a):

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from paddle_tpu.core import mesh as mesh_lib
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.module import Layer
+from paddle_tpu.core.compat import axis_size as _axis_size
 
 
 def vocab_parallel_lookup(ids, table, *, axis: str = mesh_lib.TP,
@@ -49,7 +50,7 @@ def vocab_parallel_lookup(ids, table, *, axis: str = mesh_lib.TP,
         return jnp.take(table, ids, axis=0)
 
     def body(ids, table):
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         shard_rows = table.shape[0]
         start = jax.lax.axis_index(axis) * shard_rows
         local = ids - start
@@ -67,7 +68,8 @@ def vocab_parallel_lookup(ids, table, *, axis: str = mesh_lib.TP,
         ids_spec = P(mesh_lib.BATCH_AXES)
     else:  # odd batch (or scalar ids): keep ids replicated
         ids_spec = P()
-    return jax.shard_map(
+    from paddle_tpu.core.compat import shard_map
+    return shard_map(
         body, mesh=mesh,
         in_specs=(ids_spec, P(axis, None)),
         out_specs=ids_spec,

@@ -160,7 +160,8 @@ def gpipe(
         return jax.lax.psum(outputs, axis)
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-    return jax.shard_map(
+    from paddle_tpu.core.compat import shard_map
+    return shard_map(
         stage_body, mesh=mesh,
         in_specs=(param_specs, x_spec, extras_spec),
         out_specs=x_spec,
@@ -381,7 +382,8 @@ def circular_pipeline(
         return jax.lax.psum(outputs, axis)
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), arranged)
-    return jax.shard_map(
+    from paddle_tpu.core.compat import shard_map
+    return shard_map(
         stage_body, mesh=mesh,
         in_specs=(param_specs, x_spec, extras_spec),
         out_specs=x_spec,

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.core import mesh as mesh_lib
 from paddle_tpu.ops.attention import NEG_INF
+from paddle_tpu.core.compat import axis_size as _axis_size
 
 
 def _block_update(carry, kv, *, scale, causal, q_offset, k_offset, seq_q_blk):
@@ -58,7 +59,7 @@ def _block_update(carry, kv, *, scale, causal, q_offset, k_offset, seq_q_blk):
 
 def _ring_attention_local(q, k, v, bias, *, axis, scale, causal):
     """Per-device body (inside shard_map). q,k,v local: (B,H,Sl,D)."""
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, h, sl, d = q.shape
     q32 = q.astype(jnp.float32)
@@ -176,7 +177,7 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
             lambda a: jax.lax.ppermute(a, axis, perm), x)
 
     def _ring_flash_fwd(q, k, v, bias):
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         idx = jax.lax.axis_index(axis)
         b, h, sl, d = q.shape
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -209,7 +210,7 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
 
     def vjp_bwd(res, g):
         q, k, v, bias, out, lse = res
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         idx = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % n) for i in range(n)]
         # fp32 accumulators: each ring step adds a partial; rounding to the
@@ -303,7 +304,8 @@ def ring_attention(q, k, v, *, bias=None, causal=False,
             return _ring_attention_local(q, k, v, None, axis=axis,
                                          scale=scale, causal=causal)
 
-    return jax.shard_map(
+    from paddle_tpu.core.compat import shard_map
+    return shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
         check_vma=False,
     )(*args)

@@ -19,6 +19,8 @@ from typing import Dict, List, Optional
 
 import jax
 
+from paddle_tpu import observability as _obs
+
 
 class _Events(threading.local):
     def __init__(self):
@@ -31,12 +33,18 @@ _EVENTS = _Events()
 @contextlib.contextmanager
 def record_event(name: str):
     """Annotate a region: shows up in device traces (named_scope → XLA op
-    metadata) and, under :func:`profiler`, in the host event table."""
+    metadata), in the host event table under :func:`profiler`, and —
+    always — in the observability registry's span histogram, so
+    ``observability.report()`` covers record_event spans without a
+    profiler context. (Inside jit the span fires once per TRACE, not per
+    execution — host spans measure host work.)"""
     t0 = time.perf_counter()
     with jax.named_scope(name):
         yield
+    dt = time.perf_counter() - t0
+    _obs.observe_span(name, dt)
     if _EVENTS.active is not None:
-        _EVENTS.active.append((name, time.perf_counter() - t0, t0))
+        _EVENTS.active.append((name, dt, t0))
 
 
 @contextlib.contextmanager

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 import jax
 
 from paddle_tpu import io as io_lib
+from paddle_tpu import observability
 
 
 class Trainer:
@@ -27,6 +28,14 @@ class Trainer:
     train_step(state, **batch) -> (state, metrics) — built by
     paddle_tpu.train.build_train_step (or amp.scaled_train_step) and
     optionally sharded by parallel.api.shard_train_step.
+
+    Telemetry (observability subsystem): every ``fit`` drives a
+    :class:`~paddle_tpu.observability.StepTelemetry` — step wall time,
+    examples/s (and tokens/s for token batches), data-wait vs compute
+    split, a recompile detector over jax.monitoring, periodic device
+    memory gauges, and (multi-process) a cross-host min/mean/max line.
+    ``run_log=`` additionally writes one crash-safe JSONL record per
+    step; ``telemetry=False`` turns the whole thing off.
     """
 
     def __init__(self, train_step: Callable, state: Any, *,
@@ -35,13 +44,19 @@ class Trainer:
                  keep_checkpoints: int = 3,
                  log_every: int = 100,
                  log_fn: Callable[[str], None] = print,
-                 hooks: Iterable[Callable] = ()):
+                 hooks: Iterable[Callable] = (),
+                 run_log: Optional[str] = None,
+                 telemetry: bool = True,
+                 tokens_per_example: Optional[int] = None):
         self.train_step = train_step
         self.state = state
         self.log_every = log_every
         self.log_fn = log_fn
         self.hooks = list(hooks)  # hook(trainer, step, metrics)
         self.checkpoint_every = checkpoint_every
+        self.run_log = run_log
+        self.telemetry = telemetry
+        self.tokens_per_example = tokens_per_example
         self.manager = None
         if checkpoint_dir is not None:
             self.manager = io_lib.CheckpointManager(
@@ -78,17 +93,56 @@ class Trainer:
                 "epochs > 1 with a one-shot iterator: pass make_iter= so "
                 "each epoch gets a fresh pass over the data")
         last_metrics: Dict[str, float] = {}
-        metrics: Dict[str, Any] = {}
+        tel = None
+        if self.telemetry:
+            tel = observability.StepTelemetry(
+                "train", run_log=self.run_log,
+                run_meta={"epochs": epochs},
+                log_fn=self.log_fn,
+                memory_every=self.log_every or 50,
+                aggregate_every=self.log_every)
         # host-mirrored global step: one device sync here, none in the loop
         gstep = self.step_count
+        try:
+            last_metrics = self._fit_epochs(
+                epochs, data_iter, make_iter, steps_per_epoch, tel, gstep)
+        finally:
+            if tel is not None:
+                tel.close(summary={"metrics": last_metrics})
+        if self.manager is not None:
+            last = self.step_count
+            if self.manager.latest_step() != last:
+                self.manager.save(last, jax.device_get(self.state),
+                                  wait=True, force=True)
+            else:
+                self.manager.wait()
+        return last_metrics
+
+    def _fit_epochs(self, epochs, data_iter, make_iter, steps_per_epoch,
+                    tel, gstep):
+        last_metrics: Dict[str, float] = {}
+        metrics: Dict[str, Any] = {}
         for epoch in range(epochs):
-            it = make_iter() if make_iter is not None else data_iter
+            it = iter(make_iter() if make_iter is not None else data_iter)
             t0 = time.perf_counter()
             n = 0
-            for batch in it:
+            while True:
+                t_fetch = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                if tel is not None:
+                    tel.data_wait(time.perf_counter() - t_fetch)
+                t_step = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, **batch)
                 n += 1
                 gstep += 1
+                if tel is not None:
+                    ex, tok = _batch_counts(batch, self.tokens_per_example)
+                    tel.step(gstep, feeds=batch,
+                             step_time_s=time.perf_counter() - t_step,
+                             examples=ex, tokens=tok, epoch=epoch)
                 if self.log_every and n % self.log_every == 0:
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     rate = n / (time.perf_counter() - t0)
@@ -115,13 +169,6 @@ class Trainer:
                     "iterator? pass make_iter= for multi-epoch runs)")
             last_metrics = {k: float(v) for k, v in metrics.items()}
             self.log_fn(f"[trainer] epoch {epoch} done: {_fmt(last_metrics)}")
-        if self.manager is not None:
-            last = self.step_count
-            if self.manager.latest_step() != last:
-                self.manager.save(last, jax.device_get(self.state),
-                                  wait=True, force=True)
-            else:
-                self.manager.wait()
         return last_metrics
 
     def evaluate(self, eval_step: Callable,
@@ -130,8 +177,17 @@ class Trainer:
         """Run eval_step(params, **batch) over batches; streams into
         paddle_tpu.metrics objects when given ({name: (metric, extractor)})."""
         outs = []
+        reg = observability.default() if self.telemetry else None
         for batch in data_iter:
+            t0 = time.perf_counter()
             out = eval_step(self.state["params"], **batch)
+            if reg is not None:
+                reg.histogram("eval_step_seconds",
+                              "per-batch eval wall time").observe(
+                                  time.perf_counter() - t0)
+                reg.counter("eval_steps_total").inc()
+                ex, _ = _batch_counts(batch, None)
+                reg.counter("eval_examples_total").inc(ex)
             if metrics:
                 for name, (metric, extract) in metrics.items():
                     metric.update(*extract(out, batch))
@@ -154,3 +210,22 @@ class Trainer:
 
 def _fmt(metrics: Dict[str, float]) -> str:
     return " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
+
+
+def _batch_counts(batch: Dict[str, Any], tokens_per_example: Optional[int]):
+    """(examples, tokens) for one feed dict. Examples = leading dim of
+    the first array leaf. Tokens = examples * T for (B, T) integer leaves
+    (token-id batches — BERT/GPT/Transformer feeds); None when the batch
+    doesn't look tokenized and no explicit tokens_per_example is set."""
+    leaves = [x for x in jax.tree_util.tree_leaves(batch)
+              if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1]
+    if not leaves:
+        return 0, None
+    examples = int(leaves[0].shape[0])
+    if tokens_per_example is not None:
+        return examples, examples * int(tokens_per_example)
+    tokens = None
+    for x in leaves:
+        if x.ndim == 2 and jax.numpy.issubdtype(x.dtype, jax.numpy.integer):
+            tokens = max(tokens or 0, int(x.shape[0]) * int(x.shape[1]))
+    return examples, tokens

@@ -10,10 +10,23 @@ plugin registration), so env-var-based platform selection is too late here —
 we use jax.config.update, which works until the first backend use.
 """
 
+import os
+
+# jax_num_cpu_devices only exists in newer jaxlibs; XLA_FLAGS is the
+# portable spelling and is read at backend init (not process start), so
+# setting it here — before any backend use — still takes effect.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS path above covers it
 jax.config.update("jax_threefry_partitionable", True)
 # numeric-parity tests compare kernels against numpy in true float32; the
 # backend's "default" matmul precision is bf16-class and would drown the

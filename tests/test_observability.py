@@ -106,3 +106,303 @@ class TestFleet:
         batch = {"x": np.arange(8)}
         out = fleet.local_shard(batch, index=1, num=4)
         np.testing.assert_array_equal(out["x"], [2, 3])
+
+
+# ---------------------------------------------------------------------------
+# Runtime telemetry subsystem (paddle_tpu.observability)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu import observability as obs
+
+
+class TestRegistry:
+    def test_counter_labels(self):
+        r = obs.MetricsRegistry()
+        c = r.counter("req_total", "requests")
+        c.inc(model="a").inc(2, model="a").inc(model="b")
+        assert c.value(model="a") == 3
+        assert c.value(model="b") == 1
+        assert c.value(model="zzz") == 0  # unseen series starts at 0
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge(self):
+        r = obs.MetricsRegistry()
+        g = r.gauge("mem")
+        g.set(5.0)
+        g.inc(2.5)
+        assert g.value() == pytest.approx(7.5)
+
+    def test_histogram_summary(self):
+        r = obs.MetricsRegistry()
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 3
+        assert s["min"] == pytest.approx(0.05)
+        assert s["max"] == pytest.approx(5.0)
+        assert s["mean"] == pytest.approx((0.05 + 0.5 + 5.0) / 3)
+
+    def test_type_conflict_raises(self):
+        r = obs.MetricsRegistry()
+        r.counter("x_total")
+        with pytest.raises(TypeError):
+            r.gauge("x_total")
+
+    def test_same_name_same_object(self):
+        r = obs.MetricsRegistry()
+        assert r.counter("y_total") is r.counter("y_total")
+
+    def test_snapshot_flattens(self):
+        r = obs.MetricsRegistry()
+        r.counter("c_total").inc(3, k="v")
+        r.histogram("h").observe(2.0)
+        snap = r.snapshot()
+        assert snap['c_total{k="v"}'] == 3
+        assert snap["h_count"] == 1
+        assert snap["h_mean"] == pytest.approx(2.0)
+
+
+class TestPrometheus:
+    def test_exposition_format(self):
+        r = obs.MetricsRegistry()
+        r.counter("runs_total", "bench runs").inc(2, model="bert")
+        r.gauge("mfu").set(0.41)
+        r.histogram("step_s", buckets=(0.5, 1.0)).observe(0.7)
+        text = r.render_prometheus()
+        assert "# TYPE runs_total counter" in text
+        assert 'runs_total{model="bert"} 2' in text
+        assert "# HELP runs_total bench runs" in text
+        assert "mfu 0.41" in text
+        # histogram triplet: cumulative buckets + sum + count
+        assert 'step_s_bucket{le="0.5"} 0' in text
+        assert 'step_s_bucket{le="1.0"} 1' in text
+        assert 'step_s_bucket{le="+Inf"} 1' in text
+        assert "step_s_count 1" in text
+        assert text.endswith("\n")
+
+    def test_empty_registry_renders_empty(self):
+        assert obs.MetricsRegistry().render_prometheus() == ""
+
+
+class TestRunLog:
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with obs.RunLogWriter(p, meta={"job": "t"}) as w:
+            for i in range(3):
+                w.write({"step": i, "step_time_s": 0.1,
+                         "examples_per_sec": 640.0,
+                         "metrics": {"loss": 1.0 / (i + 1)}})
+        recs = obs.read_run_log(p)
+        assert recs[0]["kind"] == "run_meta" and recs[0]["job"] == "t"
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert [r["step"] for r in steps] == [0, 1, 2]
+        assert steps[2]["metrics"]["loss"] == pytest.approx(1 / 3)
+        assert obs.validate_run_log(p, require_steps=3) == 3
+
+    def test_partial_tail_dropped(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with obs.RunLogWriter(p) as w:
+            w.write({"step": 0, "step_time_s": 0.1,
+                     "examples_per_sec": 1.0})
+        with open(p, "a") as f:
+            f.write('{"step": 1, "step_time')  # crash mid-record
+        recs = obs.read_run_log(p)
+        assert len(recs) == 1  # partial tail silently dropped
+
+    def test_validator_rejects_bad_records(self, tmp_path):
+        p = str(tmp_path / "bad.jsonl")
+        with open(p, "w") as f:
+            f.write('{"kind": "step", "ts": 1.0, "step": 0}\n')
+        with pytest.raises(ValueError, match="step_time_s"):
+            obs.validate_run_log(p)
+        with open(p, "w") as f:
+            f.write('{"kind": "nope", "ts": 1.0}\n')
+        with pytest.raises(ValueError, match="unknown kind"):
+            obs.validate_run_log(p)
+
+    def test_validator_require_steps(self, tmp_path):
+        p = str(tmp_path / "short.jsonl")
+        with obs.RunLogWriter(p) as w:
+            w.write({"step": 0, "step_time_s": 0.1,
+                     "examples_per_sec": 1.0})
+        with pytest.raises(ValueError, match="step records"):
+            obs.validate_run_log(p, require_steps=5)
+
+
+class TestRecompileDetector:
+    def test_fires_on_shape_change_only(self):
+        msgs = []
+        det = obs.RecompileDetector("t", log_fn=msgs.append,
+                                    registry=obs.MetricsRegistry())
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones((4,)))
+        det.check(step=1, feeds={"x": jnp.ones((4,))})
+        assert det.recompiles == 0          # warmup compile: counted, no warn
+        assert not msgs
+        f(jnp.ones((4,)))                    # cache hit
+        assert det.check(step=2, feeds={"x": jnp.ones((4,))}) == 0
+        f(jnp.ones((6,)))                    # deliberate retrace
+        assert det.check(step=3, feeds={"x": jnp.ones((6,))}) >= 1
+        assert det.recompiles >= 1
+        assert len(msgs) == 1
+        assert "RECOMPILATION" in msgs[0]
+        assert "float32[6]" in msgs[0]       # arg-shape signature included
+        assert "step=3" in msgs[0]
+
+    def test_shape_signature(self):
+        sig = obs.shape_signature(
+            {"b": jnp.ones((2, 3)), "a": jnp.zeros((4,), jnp.int32)})
+        assert sig == "a:int32[4] b:float32[2,3]"
+        assert obs.shape_signature(None) == "<no feeds>"
+
+
+class TestAggregate:
+    def test_single_process_noop(self):
+        out = obs.aggregate({"step_time_s": 0.25, "eps": 100.0})
+        assert out["step_time_s"]["min"] == 0.25
+        assert out["step_time_s"]["max"] == 0.25
+        assert out["step_time_s"]["mean"] == pytest.approx(0.25)
+        assert out["eps"]["argmax"] == 0
+        line = obs.format_aggregate(out)
+        assert "step_time_s" in line and "host0" in line
+
+    def test_empty(self):
+        assert obs.aggregate({}) == {}
+
+
+class TestReport:
+    def test_unified_summary_includes_spans(self):
+        from paddle_tpu import profiler as prof
+        with prof.record_event("report_span_x"):
+            pass
+        obs.counter("report_demo_total").inc()
+        text = obs.report()
+        assert "record_event spans" in text
+        assert "report_span_x" in text
+        assert "report_demo_total" in text
+
+    def test_fresh_registry_empty(self):
+        assert "no metrics recorded" in obs.report(obs.MetricsRegistry())
+
+
+class TestTrainerTelemetry:
+    def _fit(self, tmp_path, shape_break=None, steps=10):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.train import build_train_step, make_train_state
+        from paddle_tpu.nn.layers import Linear
+        from paddle_tpu.trainer import Trainer
+
+        model = Linear(4, 2)
+        optimizer = opt.SGD(learning_rate=0.1)
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+
+        def loss_fn(params, x, y):
+            pred = model(params, x)
+            return jnp.mean((pred - y) ** 2)
+
+        step = jax.jit(build_train_step(loss_fn, optimizer),
+                       donate_argnums=0)
+        rng = np.random.RandomState(0)
+
+        def batches():
+            for i in range(steps):
+                n = 8 if i != shape_break else 4
+                yield dict(x=jnp.asarray(rng.randn(n, 4), jnp.float32),
+                           y=jnp.asarray(rng.randn(n, 2), jnp.float32))
+
+        log = str(tmp_path / "run.jsonl")
+        msgs = []
+        tr = Trainer(step, state, log_every=0, run_log=log,
+                     log_fn=msgs.append)
+        tr.fit(batches())
+        return log, msgs
+
+    def test_jsonl_per_step(self, tmp_path):
+        log, _ = self._fit(tmp_path)
+        recs = obs.read_run_log(log)
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert len(steps) == 10
+        for i, r in enumerate(steps):
+            assert r["step"] == i + 1
+            assert r["step_time_s"] > 0
+            assert r["examples_per_sec"] > 0
+            assert "recompiles" in r and "data_wait_s" in r
+        assert obs.validate_run_log(log, require_steps=10) == 10
+        assert recs[-1]["kind"] == "summary"
+
+    def test_forced_shape_change_detected(self, tmp_path):
+        log, msgs = self._fit(tmp_path, shape_break=6)
+        steps = [r for r in obs.read_run_log(log) if r["kind"] == "step"]
+        assert steps[-1]["recompiles"] >= 1
+        assert steps[2]["recompiles"] == 0   # steady prefix is clean
+        warn = [m for m in msgs if "RECOMPILATION" in m]
+        assert warn and "float32[4,4]" in warn[0]
+
+    def test_telemetry_off(self, tmp_path):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.train import build_train_step, make_train_state
+        from paddle_tpu.nn.layers import Linear
+        from paddle_tpu.trainer import Trainer
+
+        model = Linear(2, 1)
+        optimizer = opt.SGD(learning_rate=0.1)
+        state = make_train_state(model, optimizer, jax.random.PRNGKey(0))
+        step = jax.jit(build_train_step(
+            lambda p, x, y: jnp.mean((model(p, x) - y) ** 2), optimizer),
+            donate_argnums=0)
+        tr = Trainer(step, state, telemetry=False, log_every=0)
+        out = tr.fit([dict(x=jnp.ones((2, 2)), y=jnp.ones((2, 1)))])
+        assert "loss" in out
+
+
+class TestBenchTelemetry:
+    def test_write_and_check_cli(self, tmp_path, monkeypatch):
+        """bench.write_bench_telemetry writes the log, the Prometheus
+        dump, and passes its own validator CLI."""
+        import importlib.util
+        import os as _os
+        import sys as _sys
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", _os.path.join(root, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        log = str(tmp_path / "bench.jsonl")
+        monkeypatch.setenv("PADDLE_TPU_METRICS_LOG", log)
+        result = {"metric": "m", "value": 10.0, "vs_baseline": 1.0,
+                  "_telemetry": {"steps": 4, "dt": 2.0,
+                                 "examples_per_step": 32,
+                                 "tokens_per_step": 64}}
+        path = bench.write_bench_telemetry(result)
+        assert path == log
+        assert "_telemetry" not in result
+        steps = [r for r in obs.read_run_log(log) if r["kind"] == "step"]
+        assert len(steps) == 4
+        assert steps[0]["examples_per_sec"] == pytest.approx(64.0)
+        assert steps[0]["tokens_per_sec"] == pytest.approx(128.0)
+        with open(log + ".prom") as f:
+            assert 'bench_value{metric="m"} 10' in f.read()
+
+
+class TestExecutorTelemetry:
+    def test_train_from_dataset_run_log(self, tmp_path):
+        from paddle_tpu.executor import Executor, Program
+
+        def fn(state, x):
+            return state, {"y": x.sum()}
+
+        def dataset():
+            for _ in range(12):
+                yield np.ones(2, np.float32)
+
+        log = str(tmp_path / "exec.jsonl")
+        exe = Executor()
+        state, fetches = exe.train_from_dataset(
+            Program(fn, name="p"), dataset, None, batch_size=4,
+            feed_builder=lambda samples: {"x": np.stack(samples)},
+            run_log=log)
+        steps = [r for r in obs.read_run_log(log) if r["kind"] == "step"]
+        assert len(steps) == 3  # 12 samples / batch 4
+        assert obs.validate_run_log(log, require_steps=3) == 3

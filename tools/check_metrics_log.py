@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Schema-validate a JSONL telemetry run log (observability.runlog).
+
+Usage:
+    python tools/check_metrics_log.py RUN.jsonl [--require-steps N]
+
+Exit 0 when every record validates (and at least N step records exist);
+exit 1 with a precise message otherwise. The bench scripts run this over
+their own logs so malformed telemetry fails fast instead of polluting
+the BENCH_* trajectory; CI can point it at any training run log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="JSONL run log to validate")
+    ap.add_argument("--require-steps", type=int, default=0,
+                    help="fail unless at least N step records are present")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import runlog
+    try:
+        n = runlog.validate_run_log(args.path,
+                                    require_steps=args.require_steps)
+    except (OSError, ValueError) as e:
+        print(f"check_metrics_log: FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"check_metrics_log: OK: {args.path} ({n} step records)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

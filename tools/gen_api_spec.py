@@ -54,6 +54,7 @@ def iter_api():
         "paddle_tpu.metrics": pt.metrics,
         "paddle_tpu.inference": pt.inference,
         "paddle_tpu.fleet": pt.fleet,
+        "paddle_tpu.observability": pt.observability,
         "paddle_tpu.profiler": pt.profiler,
         "paddle_tpu.debug": pt.debug,
         "paddle_tpu.trainer": pt.trainer,
