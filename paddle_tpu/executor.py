@@ -183,7 +183,9 @@ class Executor:
 
     def train_from_dataset(self, program, dataset, state, *,
                            batch_size=64, epochs=1, feed_builder=None,
-                           fetch_handler=None, run_log=None):
+                           fetch_handler=None, run_log=None,
+                           checkpoint_dir=None, checkpoint_every=0,
+                           resume=False, preemption_guard=None):
         """Dataset-path training (fluid executor.py:1101
         ``train_from_dataset`` → ``Executor::RunFromDataset``,
         executor.cc:168): run ``program`` over every batch of ``dataset``
@@ -194,9 +196,31 @@ class Executor:
         adapts raw reader samples; ``fetch_handler(step, fetches)``
         observes results (PrintFetchVars parity). ``run_log=`` writes one
         JSONL telemetry record per step (observability.runlog schema).
+
+        Resilience: ``checkpoint_dir`` snapshots ``state`` every
+        ``checkpoint_every`` dataset steps through the sharded snapshot
+        engine; ``resume=True`` restores the newest valid snapshot and
+        fast-forwards the (deterministic) dataset stream to the saved
+        step. ``preemption_guard`` drains the in-flight step on SIGTERM,
+        snapshots, and exits ``resilience.EXIT_PREEMPTED``.
         Returns (state, last fetches)."""
+        from paddle_tpu import io as io_lib
+
         fetches = None
         step_i = 0
+        start_step = 0
+        mgr = None
+        if checkpoint_dir is not None:
+            mgr = io_lib.CheckpointManager(
+                checkpoint_dir, save_interval_steps=max(1, checkpoint_every))
+            if resume:
+                # ONE verified scan decides the resume point; restore by
+                # explicit step then re-checks only that snapshot
+                manifest = mgr.latest_valid_manifest()
+                if manifest is not None:
+                    start_step = int(manifest["step"])
+                    state = mgr.restore(start_step,
+                                        target=jax.device_get(state))
         tel = observability.StepTelemetry(
             "executor_dataset", run_log=run_log,
             run_meta={"batch_size": batch_size, "epochs": epochs})
@@ -212,6 +236,9 @@ class Executor:
                         batch = next(it)
                     except StopIteration:
                         break
+                    if step_i < start_step:
+                        step_i += 1   # fast-forward an already-done step
+                        continue
                     tel.data_wait(time.perf_counter() - t_fetch)
                     t_step = time.perf_counter()
                     state, fetches = self.run(program, state, feed=batch,
@@ -222,8 +249,19 @@ class Executor:
                              examples=batch_size, epoch=epoch)
                     if fetch_handler is not None:
                         fetch_handler(step_i - 1, fetches)
+                    if mgr is not None and checkpoint_every \
+                            and step_i % checkpoint_every == 0:
+                        mgr.save(step_i, jax.device_get(state))
+                    if preemption_guard is not None \
+                            and preemption_guard.triggered:
+                        if mgr is not None:
+                            mgr.save(step_i, jax.device_get(state),
+                                     wait=True, force=True)
+                        preemption_guard.exit()
         finally:
             tel.close()
+            if mgr is not None:
+                mgr.wait()
         return state, fetches
 
     def infer_from_dataset(self, program, dataset, state, *,

@@ -95,6 +95,39 @@ def barrier(name: str = "fleet"):
     collective.barrier(axis=tuple(mesh.axis_names), mesh=mesh)
 
 
+def agree_on_resume_step(step: Optional[int]) -> Optional[int]:
+    """Multi-host barrier on restore: every host reports the newest valid
+    snapshot step it can see (``None`` = nothing restorable) and ALL hosts
+    adopt the minimum — the newest step the whole fleet can restore. A
+    host that committed its shards just before a crash may be ahead of
+    the others; resuming from its private step would fork the SPMD
+    program, so it drops back. If ANY host has no valid snapshot the
+    fleet starts from scratch together (returns ``None``).
+
+    Doubles as the restore-time barrier: the all-gather blocks until
+    every host arrives, so no host starts stepping before the slowest one
+    finished scanning its manifests."""
+    local = -1 if step is None else int(step)
+    if jax.process_count() == 1:
+        agreed = local
+    else:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        steps = np.asarray(multihost_utils.process_allgather(
+            jax.numpy.asarray(local, jax.numpy.int32)))
+        agreed = int(steps.min())
+        if int(steps.max()) != agreed:
+            from paddle_tpu import observability as _obs
+            _obs.counter(
+                "resilience_resume_step_disagreements_total",
+                "restores where hosts saw different latest snapshots").inc()
+            print(f"[fleet] resume-step disagreement across hosts "
+                  f"(min={agreed} max={int(steps.max())}); "
+                  f"all hosts resume from {agreed}")
+    return None if agreed < 0 else agreed
+
+
 class HeartbeatMonitor:
     """Training-stall watchdog (operators/distributed/heart_beat_monitor.h:54
     ``LostWorkerMonitor`` parity — there: pserver tracks per-worker update
@@ -157,7 +190,10 @@ class ElasticCoordinator:
 
     ``spawn_fn(rank, attempt) -> subprocess.Popen`` creates a worker;
     ``success_rc`` exits that count as done; every other exit triggers a
-    respawn while ``max_restarts`` allows.
+    respawn while ``max_restarts`` allows. Exits in ``preempt_rc``
+    (default: ``resilience.EXIT_PREEMPTED``, the drained-and-snapshotted
+    preemption code) respawn WITHOUT consuming the restart budget —
+    a preemption is the platform's doing, not the job's.
 
     ``gang=True`` (default): ANY failure kills every worker and respawns
     the whole gang at attempt+1 — required for SPMD jobs, where a
@@ -170,15 +206,21 @@ class ElasticCoordinator:
     def __init__(self, spawn_fn, num_workers: int, *,
                  max_restarts: int = 2, poll_s: float = 0.2,
                  success_rc: tuple = (0,), gang: bool = True,
+                 preempt_rc: Optional[tuple] = None,
                  log_fn=print):
+        if preempt_rc is None:
+            from paddle_tpu.resilience.preempt import EXIT_PREEMPTED
+            preempt_rc = (EXIT_PREEMPTED,)
         self.spawn_fn = spawn_fn
         self.num_workers = num_workers
         self.max_restarts = max_restarts
         self.poll_s = poll_s
         self.success_rc = tuple(success_rc)
+        self.preempt_rc = tuple(preempt_rc)
         self.gang = gang
         self.restarts = 0                      # gang restarts
         self.rank_restarts = [0] * num_workers
+        self.preemption_restarts = 0           # budget-free respawns
         self._log = log_fn
 
     def _spawn_all(self, attempt):
@@ -211,24 +253,45 @@ class ElasticCoordinator:
                     _time.sleep(self.poll_s)
                     continue
                 r, rc = failed
+                preempted = rc in self.preempt_rc
                 if self.gang:
-                    if self.restarts >= self.max_restarts:
-                        self._log(f"[elastic] rank {r} failed rc={rc}; "
-                                  "gang restart budget exhausted")
-                        return False
-                    self.restarts += 1
-                    self._log(f"[elastic] rank {r} failed rc={rc}; gang "
-                              f"restart {self.restarts}/"
-                              f"{self.max_restarts} (kill + respawn all, "
-                              "resume from checkpoint)")
+                    if preempted:
+                        # drained preemption: the platform took the slice,
+                        # not the job's fault — respawn on the house (the
+                        # run() deadline is the backstop against a
+                        # permanently-preempting pool)
+                        self.preemption_restarts += 1
+                        self._log(f"[elastic] rank {r} preempted rc={rc}; "
+                                  f"gang respawn (preemption "
+                                  f"{self.preemption_restarts}, no restart "
+                                  "budget consumed)")
+                    else:
+                        if self.restarts >= self.max_restarts:
+                            self._log(f"[elastic] rank {r} failed rc={rc}; "
+                                      "gang restart budget exhausted")
+                            return False
+                        self.restarts += 1
+                        self._log(f"[elastic] rank {r} failed rc={rc}; gang "
+                                  f"restart {self.restarts}/"
+                                  f"{self.max_restarts} (kill + respawn all, "
+                                  "resume from checkpoint)")
                     for p in procs:
                         if p.poll() is None:
                             p.kill()
                     for p in procs:
                         p.wait()
-                    procs = self._spawn_all(self.restarts)
+                    procs = self._spawn_all(
+                        self.restarts + self.preemption_restarts)
                     done = [False] * self.num_workers
                 else:
+                    if preempted:
+                        self.preemption_restarts += 1
+                        self._log(f"[elastic] rank {r} preempted rc={rc}; "
+                                  "respawn (no restart budget consumed)")
+                        procs[r] = self.spawn_fn(
+                            r, self.rank_restarts[r]
+                            + self.preemption_restarts)
+                        continue
                     if self.rank_restarts[r] >= self.max_restarts:
                         self._log(f"[elastic] rank {r} failed rc={rc}, "
                                   "restart budget exhausted")

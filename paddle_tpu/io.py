@@ -7,8 +7,11 @@ Reference mapping (SURVEY.md §5.4):
 - ``save_inference_model:974`` (prunes program to feed/fetch, serializes
   ProgramDesc) → :func:`save_inference_model` (serializes StableHLO of the
   jitted forward + params) in paddle_tpu.inference.
-- Orbax-backed async checkpointing for the distributed/large case
-  (≙ checkpoint_notify + pserver shard snapshots): :class:`CheckpointManager`.
+- Async sharded checkpointing for the distributed/large case
+  (≙ checkpoint_notify + pserver shard snapshots): :class:`CheckpointManager`,
+  a thin compatibility facade over
+  :class:`paddle_tpu.resilience.snapshot.SnapshotEngine` — per-host shard
+  files, background writes, hash-verified atomic manifests.
 """
 
 from __future__ import annotations
@@ -90,44 +93,68 @@ load_persistables = load_params
 
 
 class CheckpointManager:
-    """Async, versioned, multi-host-safe checkpointing via Orbax
-    (≙ the reference's checkpoint_notify + FleetWrapper::SaveModel world)."""
+    """Async, versioned, multi-host-safe checkpointing (≙ the reference's
+    checkpoint_notify + FleetWrapper::SaveModel world).
+
+    Compatibility facade: the engine underneath is
+    :class:`paddle_tpu.resilience.snapshot.SnapshotEngine` — per-host
+    sharded writes on a background thread, two-phase atomic manifest
+    commit, hash-verified restore that skips torn/corrupt saves. This
+    class only adds the historical ``save_interval_steps`` gating and the
+    orbax-era method names (``save/restore/latest_step/wait/close``,
+    ``.manager`` exposing ``all_steps()``)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
-        import orbax.checkpoint as ocp
+        from paddle_tpu.resilience.snapshot import SnapshotEngine
 
-        self._ocp = ocp
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            save_interval_steps=save_interval_steps,
-            enable_async_checkpointing=True)
-        self.manager = ocp.CheckpointManager(self.directory, options=options)
+        self.directory = (directory if "://" in directory
+                          else os.path.abspath(directory))
+        self.manager = SnapshotEngine(self.directory,
+                                      max_to_keep=max_to_keep)
+        self._interval = max(1, int(save_interval_steps))
+        # interval gating uses a cached high-water mark: latest_step()
+        # hash-verifies every kept snapshot, far too heavy per-step
+        self._last_saved: Optional[int] = None
 
     def save(self, step: int, state: Any, wait: bool = False,
-             force: bool = False):
+             force: bool = False) -> bool:
         """``force=True`` bypasses save_interval_steps gating — required for
-        the final end-of-fit save, which Orbax otherwise silently drops when
-        the last step is not on an interval boundary."""
-        self.manager.save(step, args=self._ocp.args.StandardSave(state),
-                          force=force)
-        if wait:
-            self.manager.wait_until_finished()
+        the final end-of-fit save, which the interval gate otherwise drops
+        when the last step is not on an interval boundary. Returns whether
+        a save was actually started."""
+        last = self._last_saved
+        if last is None:
+            # gating only needs the step NUMBER — skip hash verification
+            # (a full read of every kept snapshot) on the training thread
+            last = self._last_saved = self.manager.latest_step(verify=False)
+        if not force and self._interval > 1 and last is not None \
+                and step - last < self._interval:
+            return False
+        self.manager.save(step, state, wait=wait)
+        self._last_saved = step
+        return True
 
     def restore(self, step: Optional[int] = None, target: Optional[Any] = None):
-        if step is None:
-            step = self.manager.latest_step()
-        if step is None:
-            return None
-        if target is not None:
-            return self.manager.restore(
-                step, args=self._ocp.args.StandardRestore(target))
-        return self.manager.restore(step)
+        """Load the newest VALID snapshot (or ``step``), as host numpy
+        trees; integrity is verified before any bytes are trusted."""
+        return self.manager.restore(step, target=target)
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
+
+    @property
+    def last_saved_step(self) -> Optional[int]:
+        """High-water mark of saves issued THROUGH this manager (cheap
+        committed-manifest scan on first use; no hash pass). The
+        end-of-fit duplicate-save guard reads this instead of re-
+        verifying every kept snapshot."""
+        if self._last_saved is None:
+            self._last_saved = self.manager.latest_step(verify=False)
+        return self._last_saved
+
+    def latest_valid_manifest(self) -> Optional[dict]:
+        return self.manager.latest_valid_manifest()
 
     def wait(self):
         self.manager.wait_until_finished()

@@ -37,8 +37,16 @@ _LOG_2PI = math.log(2.0 * math.pi)
 # eager-convenience PRNG stream for sample() calls that pass neither key nor
 # seed: fresh draw per call, like the reference's seed=0 ("use a fresh engine
 # seed", gaussian_random_op.cc semantics). Under jit, pass `key` explicitly —
-# the counter advances at trace time only.
+# the counter advances at trace time only, so the implicit draw would be
+# BAKED into the compiled function; _key refuses that case loudly.
 _default_stream = iter(range(1 << 62))
+
+
+def _tracing() -> bool:
+    try:
+        return not jax.core.trace_state_clean()
+    except AttributeError:   # renamed/removed in some jax versions
+        return False
 
 
 def _key(key, seed):
@@ -46,6 +54,14 @@ def _key(key, seed):
         return key
     if seed is not None:
         return jax.random.PRNGKey(seed)
+    if _tracing():
+        raise ValueError(
+            "Distribution.sample() called with neither key= nor seed= "
+            "inside a jax trace (jit/grad/vmap/scan): the implicit fresh "
+            "draw happens at TRACE time, so the compiled function would "
+            "silently replay ONE fixed sample forever. Pass key= (split "
+            "it per step) for independent draws, or seed= to make the "
+            "fixed draw explicit.")
     return jax.random.PRNGKey(next(_default_stream))
 
 

@@ -138,7 +138,14 @@ def beam_search_decode(step_tokens, step_parents, scores, *,
     seqs = jnp.where(after, pad_id, seqs)
 
     if length_penalty > 0.0:
-        lengths = (seqs != pad_id).sum(-1).astype(jnp.float32)
+        # length = first-EOS position + 1 (cumsum of is_eos), NOT a count
+        # of non-pad tokens: a legitimate mid-sequence emission of the
+        # pad-VALUED token is part of the hypothesis and must count, or
+        # its beam gets a smaller divisor and is misranked. No EOS -> all
+        # T steps are real tokens.
+        any_eos = is_eos.any(axis=-1)
+        first_eos = jnp.argmax(is_eos, axis=-1)
+        lengths = jnp.where(any_eos, first_eos + 1, t).astype(jnp.float32)
         if bos_id is not None:
             lengths = lengths + 1.0
         scores = scores / (((5.0 + lengths) / 6.0) ** length_penalty)

@@ -1,0 +1,47 @@
+"""Resilience subsystem: survive preemption, host crashes, torn saves.
+
+The reference stack's fault tolerance is "restart from checkpoint"
+(SURVEY.md §5.3) with a synchronous whole-tree save — a single host
+failure or preemption MID-SAVE can leave the run unrestorable. This
+package makes durable, restartable state a first-class subsystem, built
+TPU-native on jax sharded arrays:
+
+- :mod:`~paddle_tpu.resilience.snapshot` — async per-host **sharded**
+  snapshots (each host writes only its addressable shards, background
+  thread, double-buffered host copy) with a two-phase **atomic manifest
+  commit**: per-shard sha256 hashes, fsync, then rename. A torn save is
+  never restorable; restore verifies integrity before loading and falls
+  back past corrupt saves.
+- :mod:`~paddle_tpu.resilience.preempt` — SIGTERM/preemption guard that
+  drains the current step, takes an emergency snapshot, and exits with
+  :data:`~paddle_tpu.resilience.preempt.EXIT_PREEMPTED` so the launcher
+  restarts without burning its crash budget.
+- :mod:`~paddle_tpu.resilience.retry` — bounded exponential backoff with
+  jitter + deadline for fs/HDFS traffic and manifest barriers, metered
+  as ``resilience_retries_total``.
+- :mod:`~paddle_tpu.resilience.faults` — deterministic fault injection
+  (kill-after-N-bytes writes, flaky fs, simulated preemption) so every
+  recovery path above is provable in CPU-only unit tests.
+
+Wired through ``Trainer`` (auto-resume from the newest VALID manifest),
+``Executor.train_from_dataset``, ``fleet`` (resume-step agreement +
+preemption-aware ElasticCoordinator) and ``io.CheckpointManager`` (now a
+thin facade over :class:`SnapshotEngine`).
+"""
+
+from paddle_tpu.resilience.faults import (FaultInjected, FlakyFS, HostDead,
+                                          TornWriteFS, corrupt_file,
+                                          simulate_preemption)
+from paddle_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionGuard
+from paddle_tpu.resilience.retry import (RetryPolicy, retry_call, retrying)
+from paddle_tpu.resilience.snapshot import (SnapshotCorruptionError,
+                                            SnapshotEngine, SnapshotError,
+                                            flatten_tree, unflatten_tree)
+
+__all__ = [
+    "EXIT_PREEMPTED", "FaultInjected", "FlakyFS", "HostDead",
+    "PreemptionGuard", "RetryPolicy", "SnapshotCorruptionError",
+    "SnapshotEngine", "SnapshotError", "TornWriteFS", "corrupt_file",
+    "flatten_tree", "retry_call", "retrying", "simulate_preemption",
+    "unflatten_tree",
+]

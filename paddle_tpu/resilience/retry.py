@@ -1,0 +1,100 @@
+"""Bounded retry with exponential backoff, jitter and a hard deadline.
+
+Reference mapping (SURVEY.md §5.3): the reference's fault story wraps
+HDFS/gRPC calls in ad-hoc shell retries (``fs.cc`` retry loops, fleet
+``hdfs.py`` re-running ``hadoop fs``); here retry is ONE policy object +
+ONE driver used by the snapshot engine (shard uploads, manifest merge
+polling) and anything else that talks to a flaky medium.
+
+Design points:
+- backoff = ``base * multiplier**(attempt-1)`` clamped to ``max_delay_s``,
+  multiplied by a ±``jitter`` fraction so a fleet of hosts retrying the
+  same dead NFS server doesn't thundering-herd it on a synchronized clock.
+- the ``deadline_s`` budget is wall-clock from the FIRST attempt; when the
+  next sleep would land past it, the ORIGINAL exception is re-raised —
+  callers see the real failure, not a retry-framework wrapper.
+- every retry bumps the ``resilience_retries_total`` counter (labelled by
+  ``op``) so a run that is quietly limping on a sick filesystem is visible
+  in the observability exposition long before it dies.
+- fully injectable (``sleep``, ``clock``, ``rng``) — the fault-injection
+  suite drives it deterministically with zero real sleeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from paddle_tpu import observability
+
+RETRYABLE_DEFAULT: Tuple[Type[BaseException], ...] = (
+    IOError, OSError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How hard to try: attempts, backoff shape, deadline, what to catch."""
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25           # ± fraction of the computed delay
+    deadline_s: float = 60.0       # wall-clock budget across ALL attempts
+    retry_on: Tuple[Type[BaseException], ...] = RETRYABLE_DEFAULT
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        d = min(self.max_delay_s,
+                self.base_delay_s * (self.multiplier ** (attempt - 1)))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, d)
+
+
+def retry_call(fn: Callable, *args,
+               policy: Optional[RetryPolicy] = None,
+               op: str = "call",
+               rng: Optional[random.Random] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic,
+               **kwargs):
+    """Run ``fn(*args, **kwargs)``, retrying ``policy.retry_on`` failures.
+
+    Gives up — re-raising the ORIGINAL exception — when either
+    ``max_attempts`` is spent or the next backoff would cross
+    ``deadline_s``. Non-retryable exceptions propagate immediately.
+    """
+    policy = policy or RetryPolicy()
+    rng = rng or random.Random()
+    start = clock()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            delay = policy.delay(attempt, rng)
+            if clock() + delay - start > policy.deadline_s:
+                raise  # the original error, not a deadline wrapper
+            observability.counter(
+                "resilience_retries_total",
+                "transient failures absorbed by resilience.retry").inc(op=op)
+            sleep(delay)
+
+
+def retrying(policy: Optional[RetryPolicy] = None, op: str = "call",
+             **driver_kwargs):
+    """Decorator form of :func:`retry_call`."""
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, op=op,
+                              **driver_kwargs, **kwargs)
+        inner.__name__ = getattr(fn, "__name__", "retrying")
+        inner.__doc__ = fn.__doc__
+        return inner
+    return wrap

@@ -1,0 +1,520 @@
+"""Async, per-host sharded snapshots with an atomic manifest commit.
+
+The durable-state half of the resilience subsystem (SURVEY.md §5.3: the
+reference's whole fault-tolerance story is restart-from-checkpoint, via
+synchronous whole-tree ``save_persistables`` + checkpoint_notify; large
+systems — TensorFlow OSDI'16 in PAPERS.md — make this a subsystem).
+
+Write path (``SnapshotEngine.save``):
+
+1. **Host copy, synchronously** (the double buffer): every jax array leaf
+   is reduced to its *addressable* shards — each host copies out only the
+   slices it owns (``Array.addressable_shards``), deduplicated by shard
+   index, so an FSDP-sharded param tree costs 1/H of its bytes per host.
+   The caller may mutate/donate the state the moment ``save`` returns.
+2. **Background write**: one worker thread serializes and writes
+   ``shards_pNNNNN.pkl`` through the injected fs (local/HDFS/fault
+   wrapper), fsyncs, then writes a ``commit_pNNNNN.json`` with the file's
+   content hash. At most ONE save is in flight and ONE pending (the
+   second buffer); a third ``save`` blocks — backpressure, not unbounded
+   host memory.
+3. **Two-phase manifest commit** (process 0): wait (with retry/deadline)
+   for every host's commit record, merge them into ``manifest.json.tmp``
+   — per-shard-file sha256 + sizes + the flat tree schema — fsync, then
+   atomically ``rename`` to ``manifest.json``. A save killed at ANY
+   earlier point leaves no manifest: the step directory is garbage, never
+   a lie.
+
+Read path: ``latest_valid_manifest`` scans step dirs newest-first and
+returns the first whose manifest parses AND whose shard files all match
+their recorded hashes — a torn or bit-rotted save is skipped, falling
+back to the previous good one. ``restore`` re-verifies hashes before
+unpickling and refuses a corrupted shard (``SnapshotCorruptionError``).
+
+Emits ``resilience_snapshot_seconds`` / ``resilience_restore_seconds``
+histograms and ``resilience_snapshots_total`` counters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu import fs as fs_lib
+from paddle_tpu import observability
+from paddle_tpu.resilience.retry import RetryPolicy, retry_call
+
+MANIFEST = "manifest.json"
+MANIFEST_TMP = MANIFEST + ".tmp"
+FORMAT_VERSION = 1
+_CHUNK = 1 << 16
+
+# marker KEY for empty dict nodes (same contract as io._flatten: structure
+# must survive the round trip or pjit sharding prefixes break on resume)
+_EMPTY_KEY = "\x00empty"
+
+
+class SnapshotError(IOError):
+    """Base class for snapshot failures."""
+
+
+class SnapshotCorruptionError(SnapshotError):
+    """A shard file does not match the hash its manifest recorded."""
+
+
+# -- pytree <-> flat dict ----------------------------------------------------
+
+def flatten_tree(tree, prefix=()) -> Dict[str, Any]:
+    if isinstance(tree, dict):
+        if not tree:
+            return {"/".join(prefix + (_EMPTY_KEY,)): np.int8(0)}
+        out = {}
+        for k in sorted(tree):
+            if not isinstance(k, str):
+                # str(k) would save fine but unflatten as a STR key — a
+                # silent structure change the target check cannot see
+                # (it str()s the target the same way). Refuse loudly.
+                raise TypeError(
+                    f"snapshot state dict keys must be str, got "
+                    f"{type(k).__name__} key {k!r} at "
+                    f"{'/'.join(prefix) or '<root>'}")
+            out.update(flatten_tree(tree[k], prefix + (k,)))
+        return out
+    if isinstance(tree, (list, tuple)):
+        # np.array would silently STACK same-shaped entries into one array
+        # and restore() would hand the stack back where the container was
+        # — corrupt state instead of a checkpoint. Refuse loudly.
+        raise TypeError(
+            f"snapshot state trees must be dicts with array leaves; got a "
+            f"{type(tree).__name__} container at "
+            f"{'/'.join(prefix) or '<root>'} — convert it to a dict "
+            "(e.g. {'0': ..., '1': ...}) before checkpointing")
+    return {"/".join(prefix): tree}
+
+
+def unflatten_tree(flat: Dict[str, Any]):
+    tree: dict = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        if parts[-1] == _EMPTY_KEY:
+            continue  # the walk above materialized the empty dict
+        node[parts[-1]] = val
+    return tree
+
+
+# -- shard extraction --------------------------------------------------------
+
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a shard's tuple-of-slices to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _host_shards(leaf) -> Tuple[Tuple[int, ...], List[Tuple[tuple, np.ndarray]]]:
+    """(global_shape, [(index, host_copy), ...]) for one leaf — only the
+    shards THIS process can address, deduplicated by index (a replicated
+    axis otherwise writes the same bytes once per local device)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+        shape = tuple(leaf.shape)
+        out, seen = [], set()
+        for s in shards:
+            idx = _norm_index(s.index, shape)
+            if idx in seen:
+                continue
+            seen.add(idx)
+            out.append((idx, np.asarray(s.data)))
+        return shape, out
+    a = np.array(leaf, copy=True)   # double-buffer guarantee for np leaves
+    return tuple(a.shape), [(tuple((0, d) for d in a.shape), a)]
+
+
+def _fsync(f):
+    f.flush()
+    try:
+        os.fsync(f.fileno())
+    except (AttributeError, OSError, ValueError):
+        pass  # fs wrappers / non-file objects: flush is the best we have
+
+
+def _write_bytes(fs, path: str, payload: bytes):
+    """Chunked write + fsync so a mid-write kill tears at a real offset."""
+    f = fs.open_write(path)
+    try:
+        for off in range(0, len(payload), _CHUNK):
+            f.write(payload[off:off + _CHUNK])
+        _fsync(f)
+    finally:
+        f.close()
+
+
+def _shard_file(process: int) -> str:
+    return f"shards_p{process:05d}.pkl"
+
+
+def _commit_file(process: int) -> str:
+    return f"commit_p{process:05d}.json"
+
+
+def _step_dirname(step: int) -> str:
+    return f"step_{int(step):010d}"
+
+
+def _parse_step(name: str) -> Optional[int]:
+    if not name.startswith("step_"):
+        return None
+    try:
+        return int(name[len("step_"):])
+    except ValueError:
+        return None
+
+
+class SnapshotEngine:
+    """Sharded, async, atomically-committed checkpoints under ``directory``.
+
+    ``fs`` defaults to scheme routing (:func:`paddle_tpu.fs.get_fs` —
+    local or HDFS); the fault-injection suite passes wrapped filesystems.
+    ``process_index``/``process_count`` default to the jax runtime; the
+    directory must be shared across hosts (NFS/HDFS) for multi-host runs.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 fs=None, retry: Optional[RetryPolicy] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 manifest_wait_s: float = 300.0):
+        if fs is None:
+            fs, directory = fs_lib.get_fs(directory)
+        else:
+            if directory.startswith("file://"):
+                directory = directory[len("file://"):]
+        self.fs = fs
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.retry = retry or RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                                          deadline_s=manifest_wait_s)
+        self.manifest_wait_s = manifest_wait_s
+        if process_index is None or process_count is None:
+            import jax
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.fs.mkdirs(self.directory)
+        self._error: Optional[BaseException] = None
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._worker = threading.Thread(
+            target=self._drain, name="snapshot-writer", daemon=True)
+        self._worker.start()
+        self._closed = False
+
+    # -- write side ---------------------------------------------------------
+    def save(self, step: int, state: Any, *, wait: bool = False):
+        """Snapshot ``state`` at ``step``. Returns once the host copy is
+        taken (double buffer) — the write happens on the worker thread;
+        ``wait=True`` blocks until the manifest is committed. A failure in
+        a previous background save is re-raised here (or in ``wait``)."""
+        self._raise_pending()
+        t0 = time.perf_counter()
+        flat = flatten_tree(state)
+        leaves = {}
+        for key, leaf in flat.items():
+            shape, shards = _host_shards(leaf)
+            leaves[key] = {"shape": shape, "shards": shards}
+        observability.histogram(
+            "resilience_snapshot_blocking_seconds",
+            "host-copy time save() spends on the caller's thread").observe(
+                time.perf_counter() - t0)
+        # blocks when one save is already pending behind the in-flight one:
+        # bounded memory, the caller feels backpressure instead of OOM
+        self._queue.put((int(step), leaves, t0))
+        if wait:
+            self.wait_until_finished()
+
+    def _drain(self):
+        while True:
+            job = self._queue.get()
+            try:
+                if job is None:
+                    return
+                step, leaves, t0 = job
+                self._write_snapshot(step, leaves)
+                observability.histogram(
+                    "resilience_snapshot_seconds",
+                    "save() start to manifest commit").observe(
+                        time.perf_counter() - t0)
+                observability.counter(
+                    "resilience_snapshots_total",
+                    "successfully committed snapshots").inc()
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _write_snapshot(self, step: int, leaves: Dict[str, dict]):
+        sdir = self._step_dir(step)
+        if self.fs.is_exist(os.path.join(sdir, MANIFEST)):
+            # step already committed: snapshots are immutable once their
+            # manifest exists, so a re-save (e.g. the emergency snapshot
+            # landing on the same step a periodic save just wrote) is a
+            # no-op. Deleting + rewriting here would race other hosts'
+            # in-flight writes for this step and destroy a good snapshot.
+            observability.counter(
+                "resilience_snapshot_already_committed_total",
+                "saves skipped because the step was already committed"
+            ).inc()
+            return
+        self.fs.mkdirs(sdir)
+        payload = pickle.dumps(
+            {"format": FORMAT_VERSION, "process": self.process_index,
+             "leaves": leaves},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hashlib.sha256(payload).hexdigest()
+        fname = _shard_file(self.process_index)
+        retry_call(_write_bytes, self.fs, os.path.join(sdir, fname),
+                   payload, policy=self.retry, op="shard_write")
+        commit = {"file": fname, "sha256": digest, "bytes": len(payload),
+                  "process": self.process_index}
+        retry_call(_write_bytes, self.fs,
+                   os.path.join(sdir, _commit_file(self.process_index)),
+                   json.dumps(commit).encode(),
+                   policy=self.retry, op="commit_write")
+        if self.process_index == 0:
+            self._commit_manifest(step, sdir, leaves)
+            self._gc()
+
+    def _commit_manifest(self, step: int, sdir: str, leaves: Dict[str, dict]):
+        """Phase two: merge every host's commit record, write tmp, fsync,
+        rename. Only an intact rename makes the snapshot visible."""
+        files = {}
+        deadline = time.monotonic() + self.manifest_wait_s
+        for p in range(self.process_count):
+            cpath = os.path.join(sdir, _commit_file(p))
+            while True:
+                if self.fs.is_exist(cpath):
+                    with self.fs.open_read(cpath) as f:
+                        rec = json.loads(f.read().decode())
+                    files[rec["file"]] = {"sha256": rec["sha256"],
+                                          "bytes": rec["bytes"]}
+                    break
+                if time.monotonic() > deadline:
+                    raise SnapshotError(
+                        f"host {p} never committed its shards for step "
+                        f"{step} (waited {self.manifest_wait_s}s)")
+                time.sleep(0.02)
+        manifest = {
+            "format": FORMAT_VERSION,
+            "step": int(step),
+            "process_count": self.process_count,
+            "files": files,
+            "tree": {k: {"shape": list(v["shape"])} for k, v in
+                     sorted(leaves.items())},
+            "created_unix": time.time(),
+        }
+        tmp = os.path.join(sdir, MANIFEST_TMP)
+        retry_call(_write_bytes, self.fs, tmp,
+                   json.dumps(manifest, indent=1).encode(),
+                   policy=self.retry, op="manifest_write")
+        self.fs.rename(tmp, os.path.join(sdir, MANIFEST))
+
+    def _gc(self):
+        """Keep the newest ``max_to_keep`` committed snapshots; also sweep
+        uncommitted (torn) step dirs strictly OLDER than the newest
+        committed one (a torn dir newer than it may be another host's
+        in-flight save — keep it).
+
+        "Committed" here means the manifest FILE exists — no hash pass:
+        GC runs after every background save, and re-reading every byte of
+        every kept snapshot per save (what ``all_steps`` does) is exactly
+        the IO the async design avoids. Integrity is the READ path's job;
+        a corrupt-but-committed snapshot ages out like a good one."""
+        committed = self._committed_steps()
+        if not committed:
+            return
+        newest = committed[-1]
+        for s in committed[:-self.max_to_keep] if self.max_to_keep else []:
+            self.fs.delete(self._step_dir(s))
+        dirs, _ = self.fs.ls_dir(self.directory)
+        for name in dirs:
+            s = _parse_step(name)
+            if s is not None and s < newest and s not in committed:
+                self.fs.delete(os.path.join(self.directory, name))
+
+    # -- read side ----------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, _step_dirname(step))
+
+    def _candidate_steps(self) -> List[int]:
+        dirs, _ = self.fs.ls_dir(self.directory)
+        steps = [s for s in (_parse_step(d) for d in dirs) if s is not None]
+        return sorted(steps)
+
+    def _committed_steps(self) -> List[int]:
+        """Steps whose manifest FILE exists, ascending — a cheap existence
+        scan, NO hash verification (use for gating/GC, not for restore)."""
+        return [s for s in self._candidate_steps()
+                if self.fs.is_exist(os.path.join(self._step_dir(s),
+                                                 MANIFEST))]
+
+    def _load_manifest(self, step: int) -> dict:
+        """Parse + hash-verify one step's manifest; raises on any defect."""
+        sdir = self._step_dir(step)
+        mpath = os.path.join(sdir, MANIFEST)
+        if not self.fs.is_exist(mpath):
+            raise SnapshotError(f"no manifest for step {step} (torn save?)")
+        with self.fs.open_read(mpath) as f:
+            manifest = json.loads(f.read().decode())
+        if manifest.get("format") != FORMAT_VERSION:
+            raise SnapshotError(
+                f"manifest format {manifest.get('format')!r} != "
+                f"{FORMAT_VERSION}")
+        for fname, meta in manifest["files"].items():
+            fpath = os.path.join(sdir, fname)
+            if not self.fs.is_exist(fpath):
+                raise SnapshotCorruptionError(
+                    f"step {step}: shard file {fname} is missing")
+            h = hashlib.sha256()
+            n = 0
+            with self.fs.open_read(fpath) as f:
+                while True:
+                    chunk = f.read(_CHUNK)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+                    n += len(chunk)
+            if n != meta["bytes"] or h.hexdigest() != meta["sha256"]:
+                raise SnapshotCorruptionError(
+                    f"step {step}: shard file {fname} fails verification "
+                    f"(got {n}B/{h.hexdigest()[:12]}, manifest says "
+                    f"{meta['bytes']}B/{meta['sha256'][:12]})")
+        return manifest
+
+    def latest_valid_manifest(self) -> Optional[dict]:
+        """Newest manifest that parses AND verifies, skipping past torn or
+        corrupted saves. None when no restorable snapshot exists."""
+        for step in reversed(self._candidate_steps()):
+            try:
+                return self._load_manifest(step)
+            except SnapshotError:
+                observability.counter(
+                    "resilience_invalid_snapshots_total",
+                    "snapshots skipped as torn/corrupt during scan").inc()
+        return None
+
+    def all_steps(self) -> List[int]:
+        """Steps with a valid (verified) manifest, ascending."""
+        out = []
+        for step in self._candidate_steps():
+            try:
+                self._load_manifest(step)
+                out.append(step)
+            except SnapshotError:
+                pass
+        return out
+
+    def latest_step(self, *, verify: bool = True) -> Optional[int]:
+        """Newest restorable step. ``verify=True`` hash-checks (what a
+        resume decision needs); ``verify=False`` is a cheap committed-
+        manifest scan for gating/bookkeeping on hot paths."""
+        if not verify:
+            committed = self._committed_steps()
+            return committed[-1] if committed else None
+        m = self.latest_valid_manifest()
+        return None if m is None else int(m["step"])
+
+    def restore(self, step: Optional[int] = None, *, target: Any = None):
+        """Load a snapshot into a host-numpy pytree. ``step=None`` takes
+        the newest valid one (falling back past corrupt saves); an
+        explicit ``step`` is verified and REFUSED if corrupted. With
+        ``target``, key/shape agreement is enforced first.
+
+        Scale note: every host reads ALL shard files and assembles the
+        FULL global array per leaf — the 1/H-bytes-per-host win currently
+        applies to the write path only. Restoring each host's shards
+        directly onto device placements (skipping the global assembly,
+        for models that only fit sharded) is a known open item
+        (ROADMAP)."""
+        t0 = time.perf_counter()
+        if step is None:
+            manifest = self.latest_valid_manifest()
+            if manifest is None:
+                return None
+            step = int(manifest["step"])
+        else:
+            manifest = self._load_manifest(step)  # raises on corruption
+        sdir = self._step_dir(step)
+        assembled: Dict[str, List[Tuple[tuple, np.ndarray]]] = {}
+        shapes: Dict[str, tuple] = {}
+        for fname in manifest["files"]:
+            with self.fs.open_read(os.path.join(sdir, fname)) as f:
+                part = pickle.loads(f.read())
+            for key, rec in part["leaves"].items():
+                shapes[key] = tuple(rec["shape"])
+                assembled.setdefault(key, []).extend(rec["shards"])
+        flat = {}
+        for key, shards in assembled.items():
+            shape = shapes[key]
+            if len(shards) == 1 and _covers_all(shards[0][0], shape):
+                flat[key] = shards[0][1]
+                continue
+            out = np.empty(shape, dtype=shards[0][1].dtype)
+            for idx, data in shards:
+                out[tuple(slice(a, b) for a, b in idx)] = data
+            flat[key] = out
+        if target is not None:
+            tflat = flatten_tree(target)
+            missing = set(tflat) - set(flat)
+            extra = set(flat) - set(tflat)
+            if missing or extra:
+                raise SnapshotError(
+                    f"snapshot/target mismatch: missing={sorted(missing)[:5]}"
+                    f" extra={sorted(extra)[:5]}")
+            for k, v in tflat.items():
+                if hasattr(v, "shape") and tuple(np.shape(flat[k])) != \
+                        tuple(v.shape):
+                    raise SnapshotError(
+                        f"shape mismatch for {k}: {np.shape(flat[k])} vs "
+                        f"{v.shape}")
+        tree = unflatten_tree(flat)
+        observability.histogram(
+            "resilience_restore_seconds",
+            "verified manifest to assembled host pytree").observe(
+                time.perf_counter() - t0)
+        return tree
+
+    # -- lifecycle ----------------------------------------------------------
+    def _raise_pending(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def wait_until_finished(self):
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._worker.join()
+        self._raise_pending()
+
+
+def _covers_all(idx, shape) -> bool:
+    return all(a == 0 and b == d for (a, b), d in zip(idx, shape))

@@ -36,6 +36,14 @@ class Trainer:
     memory gauges, and (multi-process) a cross-host min/mean/max line.
     ``run_log=`` additionally writes one crash-safe JSONL record per
     step; ``telemetry=False`` turns the whole thing off.
+
+    Resilience (resilience subsystem): checkpoints go through the sharded
+    snapshot engine — ``restore()`` resumes from the newest *valid*
+    manifest, silently falling back past torn/corrupt saves, and (multi-
+    host) barriers so every host agrees on the resume step. Passing
+    ``preemption_guard=`` makes ``fit`` drain the in-flight step when
+    SIGTERM arrives, take a forced emergency snapshot, and exit with
+    ``resilience.EXIT_PREEMPTED`` for the launcher.
     """
 
     def __init__(self, train_step: Callable, state: Any, *,
@@ -47,7 +55,8 @@ class Trainer:
                  hooks: Iterable[Callable] = (),
                  run_log: Optional[str] = None,
                  telemetry: bool = True,
-                 tokens_per_example: Optional[int] = None):
+                 tokens_per_example: Optional[int] = None,
+                 preemption_guard=None):
         self.train_step = train_step
         self.state = state
         self.log_every = log_every
@@ -57,6 +66,7 @@ class Trainer:
         self.run_log = run_log
         self.telemetry = telemetry
         self.tokens_per_example = tokens_per_example
+        self.preemption_guard = preemption_guard
         self.manager = None
         if checkpoint_dir is not None:
             self.manager = io_lib.CheckpointManager(
@@ -65,11 +75,20 @@ class Trainer:
 
     # -- resume ------------------------------------------------------------
     def restore(self) -> int:
-        """Resume from the newest checkpoint if one exists. Returns the
-        restored step (0 if none)."""
-        if self.manager is None or self.manager.latest_step() is None:
+        """Resume from the newest VALID checkpoint if one exists (torn or
+        corrupt saves are skipped by the snapshot engine). Multi-host runs
+        barrier so every host resumes at the SAME step — a host whose
+        local view is ahead (e.g. it committed before the crash, others
+        did not) drops back to the common step. Returns the restored step
+        (0 if none)."""
+        if self.manager is None:
             return 0
-        restored = self.manager.restore(target=jax.device_get(self.state))
+        step = self.manager.latest_step()
+        agreed = _agree_on_resume_step(step)
+        if agreed is None:
+            return 0
+        restored = self.manager.restore(
+            agreed, target=jax.device_get(self.state))
         self.state = restored
         step = int(restored["step"])
         self.log_fn(f"[trainer] resumed from step {step}")
@@ -111,7 +130,9 @@ class Trainer:
                 tel.close(summary={"metrics": last_metrics})
         if self.manager is not None:
             last = self.step_count
-            if self.manager.latest_step() != last:
+            # cached high-water mark, not latest_step(): the latter hash-
+            # verifies every kept snapshot, a full read per fit() end
+            if self.manager.last_saved_step != last:
                 self.manager.save(last, jax.device_get(self.state),
                                   wait=True, force=True)
             else:
@@ -161,6 +182,13 @@ class Trainer:
                     self.manager.save(gstep, host_state)
                 for hook in self.hooks:
                     hook(self, n, metrics)
+                if self.preemption_guard is not None \
+                        and self.preemption_guard.triggered:
+                    # the in-flight step has drained (device_get below
+                    # syncs XLA's async dispatch); snapshot and leave with
+                    # the launcher-visible preemption code
+                    self._emergency_snapshot()
+                    self.preemption_guard.exit()
                 if steps_per_epoch and n >= steps_per_epoch:
                     break
             if n == 0:
@@ -206,6 +234,23 @@ class Trainer:
             out = predict_step(self.state["params"], **batch)
             outs.append(jax.device_get(out))   # pytree -> host numpy
         return outs
+
+
+    def _emergency_snapshot(self):
+        """Forced synchronous snapshot of the current state (preemption
+        drain path); a no-op without a checkpoint manager."""
+        if self.manager is None:
+            return
+        host_state = jax.device_get(self.state)
+        step = int(host_state["step"])
+        self.manager.save(step, host_state, wait=True, force=True)
+        self.log_fn(f"[trainer] emergency snapshot at step {step}")
+
+
+def _agree_on_resume_step(step):
+    """Multi-host agreement on the resume step (None = no checkpoint)."""
+    from paddle_tpu import fleet as fleet_lib
+    return fleet_lib.agree_on_resume_step(step)
 
 
 def _fmt(metrics: Dict[str, float]) -> str:

@@ -132,6 +132,25 @@ class TestBeamSearchDecode:
         np.testing.assert_array_equal(seqs[0, 0], [5, 6, 7])
         assert sc[0, 0] >= sc[0, 1]
 
+    def test_length_counts_mid_sequence_pad_valued_token(self):
+        """Length for the penalty comes from the first-EOS position, so a
+        legitimate pad-VALUED token emitted before EOS still counts
+        toward length (ADVICE round 5: counting non-pad tokens misranked
+        such beams)."""
+        from paddle_tpu.ops.beam_search import beam_search_decode as bsd
+
+        # beam 0 emits [4, 0, 3]: token 0 == pad_id mid-sequence, EOS at
+        # t=2 -> length 3. beam 1 emits [5, 3, pad]: EOS at t=1 ->
+        # length 2. Same raw score: the longer beam 0 must win under a
+        # negative-score GNMT penalty.
+        toks = jnp.array([[[4, 5], [0, 3], [3, 9]]])
+        pars = jnp.array([[[0, 1], [0, 1], [0, 1]]])
+        scores = jnp.array([[-3.0, -3.0]])
+        seqs, sc = bsd(toks, pars, scores, eos_id=3, pad_id=0,
+                       length_penalty=1.0)
+        np.testing.assert_array_equal(seqs[0, 0], [4, 0, 3])
+        assert sc[0, 0] > sc[0, 1]
+
 
 class TestMachineTranslationSeq2Seq:
     def _toy(self):

@@ -210,6 +210,20 @@ def test_default_sample_is_fresh():
     np.testing.assert_array_equal(s1, s2)
 
 
+def test_keyless_sample_under_trace_raises():
+    """sample() without key/seed inside jit would bake ONE draw into the
+    compiled function (ADVICE round 5) — it must refuse loudly instead."""
+    d = Normal(0.0, 1.0)
+
+    with pytest.raises(ValueError, match="trace"):
+        jax.jit(lambda: d.sample((2,)))()
+    # explicit key and explicit seed both stay legal under jit
+    out = jax.jit(lambda k: d.sample((2,), key=k))(jax.random.PRNGKey(0))
+    assert out.shape == (2,)
+    out = jax.jit(lambda: d.sample((2,), seed=3))()
+    assert out.shape == (2,)
+
+
 def test_uniform_own_sample_in_support():
     # jax.random.uniform includes 0.0 -> sample can be exactly `low`;
     # log_prob of a self-drawn sample must be finite

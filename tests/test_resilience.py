@@ -1,0 +1,425 @@
+"""Resilience subsystem tests, driven by the fault-injection harness.
+
+Acceptance criteria (ISSUE 2), all on CPU:
+  (a) a save killed mid-write leaves the previous checkpoint restorable
+      and the torn one invisible to ``latest_valid_manifest()``;
+  (b) kill-and-resume of ``Trainer.fit`` reproduces bit-identical params
+      vs an uninterrupted run at the same step;
+  (c) restore verifies shard hashes and refuses a corrupted shard;
+  (d) retry/backoff recovers from K injected transient fs failures and
+      gives up past the deadline with the ORIGINAL error.
+"""
+
+import glob
+import itertools
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import fs as fs_lib
+from paddle_tpu import optimizer as opt
+from paddle_tpu.resilience import (EXIT_PREEMPTED, FaultInjected, FlakyFS,
+                                   HostDead, PreemptionGuard, RetryPolicy,
+                                   SnapshotCorruptionError, SnapshotEngine,
+                                   TornWriteFS, corrupt_file, retry_call,
+                                   simulate_preemption)
+from paddle_tpu.train import build_train_step
+from paddle_tpu.trainer import Trainer
+
+
+def _state(step=3):
+    return {"params": {"w": jnp.arange(8.0), "b": jnp.ones((2, 2))},
+            "opt": {"slots": {}},        # empty node: structure must survive
+            "step": jnp.asarray(step, jnp.int32)}
+
+
+def _shard_files(directory, step):
+    return sorted(glob.glob(os.path.join(
+        directory, f"step_{step:010d}", "shards_*.pkl")))
+
+
+class TestSnapshotEngine:
+    def test_roundtrip_with_empty_nodes(self, tmp_path):
+        eng = SnapshotEngine(str(tmp_path), max_to_keep=2)
+        state = _state()
+        eng.save(3, state, wait=True)
+        assert eng.latest_step() == 3
+        back = eng.restore(target=jax.device_get(state))
+        np.testing.assert_array_equal(back["params"]["w"], np.arange(8.0))
+        assert back["opt"]["slots"] == {}       # empty dict came back
+        assert int(back["step"]) == 3
+        eng.close()
+
+    def test_sharded_leaves_one_copy_per_unique_shard(self, tmp_path, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import pickle
+
+        x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh8, P("dp")))
+        y = jax.device_put(jnp.ones((4, 4)), NamedSharding(mesh8, P()))
+        eng = SnapshotEngine(str(tmp_path))
+        eng.save(1, {"x": x, "y": y}, wait=True)
+        back = eng.restore(1)
+        np.testing.assert_array_equal(back["x"], np.arange(16.0))
+        np.testing.assert_array_equal(back["y"], np.ones((4, 4)))
+        part = pickle.load(open(_shard_files(str(tmp_path), 1)[0], "rb"))
+        # dp-sharded leaf: one slice per device; replicated leaf: deduped
+        # to a single copy, not 8 identical ones
+        assert len(part["leaves"]["x"]["shards"]) == 8
+        assert len(part["leaves"]["y"]["shards"]) == 1
+        eng.close()
+
+    def test_resave_of_committed_step_is_noop(self, tmp_path):
+        """Snapshots are immutable once committed: re-saving the same step
+        (periodic save then emergency snapshot at the same step) must not
+        delete/rewrite the good snapshot — in multi-host that destroyed
+        other hosts' shards and hung the manifest merge."""
+        eng = SnapshotEngine(str(tmp_path))
+        eng.save(3, _state(3), wait=True)
+        before = open(_shard_files(str(tmp_path), 3)[0], "rb").read()
+        eng.save(3, {"params": {"w": jnp.zeros(8)},
+                     "opt": {"slots": {}},
+                     "step": jnp.asarray(3, jnp.int32)}, wait=True)
+        after = open(_shard_files(str(tmp_path), 3)[0], "rb").read()
+        assert before == after                 # first commit wins, intact
+        np.testing.assert_array_equal(eng.restore(3)["params"]["w"],
+                                      np.arange(8.0))
+        eng.close()
+
+    def test_non_dict_containers_refused_loudly(self, tmp_path):
+        """A tuple in the state tree must raise, not be silently stacked
+        into a single ndarray that restore() would hand back; same for
+        non-str dict keys, which would come back as STR keys."""
+        eng = SnapshotEngine(str(tmp_path))
+        with pytest.raises(TypeError, match="container"):
+            eng.save(1, {"opt": (jnp.ones(2), jnp.ones(2))}, wait=True)
+        with pytest.raises(TypeError, match="str"):
+            eng.save(1, {"layers": {0: jnp.ones(2)}}, wait=True)
+        eng.close()
+
+    def test_gc_keeps_newest(self, tmp_path):
+        eng = SnapshotEngine(str(tmp_path), max_to_keep=2)
+        for s in (1, 2, 3):
+            eng.save(s, _state(s), wait=True)
+        assert eng.all_steps() == [2, 3]
+        eng.close()
+
+    # -- (a) torn save ------------------------------------------------------
+    def test_torn_save_invisible_previous_restorable(self, tmp_path):
+        d = str(tmp_path)
+        eng = SnapshotEngine(d)
+        eng.save(1, _state(1), wait=True)
+        good = eng.restore(1)
+
+        torn_fs = TornWriteFS(fs_lib.LocalFS(), kill_after_bytes=150)
+        eng2 = SnapshotEngine(d, fs=torn_fs,
+                              retry=RetryPolicy(max_attempts=1))
+        with pytest.raises(FaultInjected):
+            eng2.save(2, _state(2), wait=True)
+        assert torn_fs.dead  # the "host" really died mid-write
+        # everything after the kill point fails too: no zombie manifest
+        with pytest.raises(HostDead):
+            torn_fs.open_write(os.path.join(d, "x"))
+
+        # a fresh process sees only the intact snapshot
+        eng3 = SnapshotEngine(d)
+        m = eng3.latest_valid_manifest()
+        assert m is not None and m["step"] == 1
+        assert eng3.all_steps() == [1]
+        back = eng3.restore()
+        assert int(back["step"]) == 1
+        np.testing.assert_array_equal(back["params"]["w"],
+                                      good["params"]["w"])
+        eng.close(), eng3.close()
+
+    # -- (c) corruption refused, fallback past it ---------------------------
+    def test_restore_refuses_corrupted_shard(self, tmp_path):
+        d = str(tmp_path)
+        eng = SnapshotEngine(d, max_to_keep=3)
+        eng.save(1, _state(1), wait=True)
+        eng.save(2, _state(2), wait=True)
+        corrupt_file(_shard_files(d, 2)[0])
+        with pytest.raises(SnapshotCorruptionError):
+            eng.restore(2)                   # explicit step: refused
+        assert eng.latest_step() == 1        # scan falls back past it
+        assert int(eng.restore()["step"]) == 1
+        eng.close()
+
+    def test_two_phase_commit_merges_all_hosts(self, tmp_path):
+        """Process 0 only publishes the manifest once EVERY host's commit
+        record (with its content hash) has landed — the shared-fs version
+        of the restore barrier."""
+        d = str(tmp_path)
+        p1 = SnapshotEngine(d, process_index=1, process_count=2)
+        p1.save(1, _state(1), wait=True)     # shards + commit, no manifest
+        assert SnapshotEngine(d).latest_valid_manifest() is None
+        p0 = SnapshotEngine(d, process_index=0, process_count=2)
+        p0.save(1, _state(1), wait=True)     # merges both commits
+        m = p0.latest_valid_manifest()
+        assert m["step"] == 1 and len(m["files"]) == 2
+        back = p0.restore(1)
+        np.testing.assert_array_equal(back["params"]["w"], np.arange(8.0))
+        p0.close(), p1.close()
+
+    def test_missing_host_commit_times_out(self, tmp_path):
+        p0 = SnapshotEngine(str(tmp_path), process_index=0, process_count=2,
+                            manifest_wait_s=0.2)
+        with pytest.raises(IOError):
+            p0.save(1, _state(1), wait=True)  # host 1 never shows up
+        assert p0.latest_valid_manifest() is None
+        p0.close()
+
+
+class TestRetry:
+    # -- (d) transient recovery + deadline give-up --------------------------
+    def test_recovers_from_k_transient_failures(self, tmp_path):
+        flaky = FlakyFS(fs_lib.LocalFS(), fail_times=3)
+        path = str(tmp_path / "f.bin")
+
+        def write():
+            f = flaky.open_write(path)
+            f.write(b"payload")
+            f.close()
+
+        retry_call(write, policy=RetryPolicy(base_delay_s=0.001), op="test")
+        assert flaky.failures_injected == 3
+        assert open(path, "rb").read() == b"payload"
+
+    def test_gives_up_past_deadline_with_original_error(self):
+        original = IOError("the real failure")
+
+        def always_fails():
+            raise original
+
+        fake_now = itertools.count(0, 10)  # each attempt "takes" 10s
+        with pytest.raises(IOError) as ei:
+            retry_call(always_fails,
+                       policy=RetryPolicy(max_attempts=100,
+                                          deadline_s=25.0,
+                                          base_delay_s=0.001),
+                       sleep=lambda s: None,
+                       clock=lambda: float(next(fake_now)))
+        assert ei.value is original          # not a retry-framework wrapper
+
+    def test_gives_up_after_max_attempts(self):
+        calls = []
+
+        def always_fails():
+            calls.append(1)
+            raise IOError("nope")
+
+        with pytest.raises(IOError):
+            retry_call(always_fails,
+                       policy=RetryPolicy(max_attempts=4, base_delay_s=0.0))
+        assert len(calls) == 4
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def typo():
+            calls.append(1)
+            raise ValueError("bug, not weather")
+
+        with pytest.raises(ValueError):
+            retry_call(typo, policy=RetryPolicy(base_delay_s=0.0))
+        assert len(calls) == 1
+
+    def test_snapshot_survives_flaky_fs(self, tmp_path):
+        """End-to-end: the engine's own writes ride the retry policy."""
+        flaky = FlakyFS(fs_lib.LocalFS(), fail_times=2)
+        eng = SnapshotEngine(str(tmp_path), fs=flaky,
+                             retry=RetryPolicy(max_attempts=5,
+                                               base_delay_s=0.001))
+        eng.save(1, _state(1), wait=True)
+        assert flaky.failures_injected == 2
+        assert eng.latest_step() == 1
+        eng.close()
+
+
+def _toy_trainer_parts():
+    optimizer = opt.SGD(learning_rate=0.1)
+    params = {"w": jnp.full((4, 2), 0.5), "b": jnp.zeros((2,))}
+    state = {"params": params, "opt": optimizer.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(params, x, y):
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    return state, jax.jit(build_train_step(loss_fn, optimizer))
+
+
+def _toy_batches(n=10):
+    rng = np.random.default_rng(0)
+    return [{"x": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(8, 2)), jnp.float32)}
+            for _ in range(n)]
+
+
+class TestPreemption:
+    def test_sigterm_sets_flag_and_restores_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        guard = PreemptionGuard()
+        try:
+            assert not guard.triggered
+            simulate_preemption(real_signal=True)
+            assert guard.triggered
+        finally:
+            guard.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+    # -- (b) kill-and-resume bit-identical ----------------------------------
+    def test_trainer_kill_and_resume_bit_identical(self, tmp_path):
+        batches = _toy_batches(10)
+        quiet = dict(telemetry=False, log_fn=lambda s: None,
+                     checkpoint_every=4)
+
+        # uninterrupted reference run
+        state, step = _toy_trainer_parts()
+        ref = Trainer(step, state, checkpoint_dir=str(tmp_path / "a"),
+                      **quiet)
+        ref.fit(batches)
+        assert ref.step_count == 10
+
+        # preempted run: SIGTERM "arrives" during step 6; the step drains,
+        # an emergency snapshot lands, the process exits EXIT_PREEMPTED
+        state_b, _ = _toy_trainer_parts()
+        guard = PreemptionGuard(install=False)
+        kill_hook = (lambda tr, n, m:
+                     simulate_preemption(guard) if n == 6 else None)
+        pre = Trainer(step, state_b, checkpoint_dir=str(tmp_path / "b"),
+                      preemption_guard=guard, hooks=[kill_hook], **quiet)
+        with pytest.raises(SystemExit) as ei:
+            pre.fit(batches)
+        assert ei.value.code == EXIT_PREEMPTED
+
+        # "new process": fresh state, auto-resume, finish the same data
+        state_c, _ = _toy_trainer_parts()
+        res = Trainer(step, state_c, checkpoint_dir=str(tmp_path / "b"),
+                      **quiet)
+        assert res.restore() == 6
+        res.fit(batches[6:])
+        assert res.step_count == 10
+
+        ref_flat = jax.device_get(ref.state)
+        res_flat = jax.device_get(res.state)
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(ref_flat["params"][k],
+                                          res_flat["params"][k])
+
+    def test_trainer_resume_skips_corrupt_newest(self, tmp_path):
+        """Auto-resume falls back past a corrupted newest checkpoint."""
+        batches = _toy_batches(8)
+        state, step = _toy_trainer_parts()
+        tr = Trainer(step, state, checkpoint_dir=str(tmp_path),
+                     checkpoint_every=4, telemetry=False,
+                     log_fn=lambda s: None)
+        tr.fit(batches)                       # snapshots at 4 and 8
+        corrupt_file(_shard_files(str(tmp_path), 8)[0])
+        state2, _ = _toy_trainer_parts()
+        tr2 = Trainer(step, state2, checkpoint_dir=str(tmp_path),
+                      checkpoint_every=4, telemetry=False,
+                      log_fn=lambda s: None)
+        assert tr2.restore() == 4             # not the torn 8
+        assert int(tr2.state["step"]) == 4
+
+
+class TestExecutorResilience:
+    def _parts(self):
+        from paddle_tpu.executor import Executor, Program
+
+        optimizer = opt.SGD(learning_rate=0.1)
+        params = {"w": jnp.full((3, 3), 0.25)}
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+
+        def loss_fn(params, x):
+            return jnp.mean((x @ params["w"] - x) ** 2)
+
+        step = build_train_step(loss_fn, optimizer)
+        rng = np.random.default_rng(1)
+        samples = [rng.normal(size=(3,)).astype(np.float32)
+                   for _ in range(12)]
+        dataset = lambda: iter(samples)                      # noqa: E731
+        feed = lambda buf: {"x": np.stack(buf)}              # noqa: E731
+        return (Executor(), Program(step, name="res_toy"), state, dataset,
+                feed)
+
+    def test_train_from_dataset_preempt_then_resume(self, tmp_path):
+        exe, prog, state, dataset, feed = self._parts()
+        full_state, _ = exe.train_from_dataset(
+            prog, dataset, state, batch_size=2, epochs=1,
+            feed_builder=feed)
+
+        guard = PreemptionGuard(install=False)
+        trip = (lambda i, fetches:
+                simulate_preemption(guard) if i == 2 else None)
+        with pytest.raises(SystemExit) as ei:
+            exe.train_from_dataset(
+                prog, dataset, state, batch_size=2, epochs=1,
+                feed_builder=feed, checkpoint_dir=str(tmp_path),
+                preemption_guard=guard, fetch_handler=trip)
+        assert ei.value.code == EXIT_PREEMPTED
+
+        resumed_state, _ = exe.train_from_dataset(
+            prog, dataset, state, batch_size=2, epochs=1,
+            feed_builder=feed, checkpoint_dir=str(tmp_path), resume=True)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(full_state)["params"]["w"]),
+            np.asarray(jax.device_get(resumed_state)["params"]["w"]))
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self.returncode = rc
+        self.killed = False
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        self.killed = True
+
+    def wait(self):
+        return self.returncode
+
+
+class TestElasticPreemption:
+    def test_preempt_exit_does_not_consume_restart_budget(self):
+        from paddle_tpu.fleet import ElasticCoordinator
+
+        script = {0: [EXIT_PREEMPTED, EXIT_PREEMPTED, 0], 1: [0, 0, 0]}
+        spawned = []
+
+        def spawn(rank, attempt):
+            spawned.append((rank, attempt))
+            return _FakeProc(script[rank][min(attempt,
+                                              len(script[rank]) - 1)])
+
+        coord = ElasticCoordinator(spawn, 2, max_restarts=0,
+                                   log_fn=lambda s: None)
+        assert coord.run(timeout_s=10.0)
+        assert coord.restarts == 0            # budget untouched
+        assert coord.preemption_restarts == 2
+
+    def test_crash_still_consumes_budget(self):
+        from paddle_tpu.fleet import ElasticCoordinator
+
+        def spawn(rank, attempt):
+            return _FakeProc(9)               # always crashes
+
+        coord = ElasticCoordinator(spawn, 1, max_restarts=1,
+                                   log_fn=lambda s: None)
+        assert not coord.run(timeout_s=10.0)
+        assert coord.restarts == 1
+
+
+class TestResumeAgreement:
+    def test_single_host_passthrough(self):
+        from paddle_tpu import fleet
+
+        assert fleet.agree_on_resume_step(7) == 7
+        assert fleet.agree_on_resume_step(None) is None

@@ -6,12 +6,26 @@ Measures, for a matmul-heavy serving graph (the int8 win case):
   - executable/device memory via memory_analysis()
 Prints ONE JSON line; run inside the TPU session for the hardware
 numbers (CPU run is labeled honestly).
+
+``--dryrun`` shrinks everything to CPU-smoke size and self-validates the
+output schema — tools/run_ci.sh runs it so bench bitrot is caught by CI,
+not by a burning TPU session (round-5 lost its int8 window to an import
+error this very file shipped with).
 """
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
+
+# run from anywhere: the repo root is this file's parent dir (round 5's
+# crash was exactly this line missing — `python tools/int8_bench.py` has
+# tools/ on sys.path, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REQUIRED_KEYS = ("device", "float32", "int8", "int8_vs_float_latency",
+                  "max_abs_diff")
 
 
 def main():
@@ -20,7 +34,12 @@ def main():
     ap.add_argument("--layers", type=int, default=8)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--dryrun", action="store_true",
+                    help="tiny CPU smoke run + output-schema self-check")
     args = ap.parse_args()
+    if args.dryrun:
+        args.dim, args.layers, args.batch = 64, 2, 2
+        args.iters = min(args.iters, 3)
 
     import jax
     import jax.numpy as jnp
@@ -78,6 +97,17 @@ def main():
     d = float(jnp.max(jnp.abs(jnp.asarray(results["float32"]) -
                               jnp.asarray(results["int8"]))))
     out["max_abs_diff"] = d
+    if args.dryrun:
+        out["dryrun"] = True
+        missing = [k for k in _REQUIRED_KEYS if k not in out]
+        if missing:
+            print(f"int8_bench dryrun: missing output keys {missing}",
+                  file=sys.stderr)
+            return 1
+        if not (d == d and d < 1.0):   # NaN-safe sanity on the quant error
+            print(f"int8_bench dryrun: implausible max_abs_diff {d}",
+                  file=sys.stderr)
+            return 1
     print(json.dumps(out))
     return 0
 

@@ -28,6 +28,11 @@ export JAX_PLATFORMS=cpu
 echo "== quick tier =="
 $PYTEST tests/ -m "not slow"
 
+# bench-bitrot smoke: the TPU-session scripts must at least run end-to-end
+# on CPU (round 5 lost its int8 hardware window to an import error here)
+echo "== bench smoke (int8 dryrun) =="
+python tools/int8_bench.py --dryrun > /dev/null
+
 if [ "$MODE" = "--quick" ]; then
   echo "CI OK (quick tier)"
   exit 0
