@@ -8,10 +8,10 @@ runtime services (data feeding, inference serving) are native C++.
 
 from paddle_tpu.version import __version__
 
-from paddle_tpu import (amp, config, core, data, debug, fleet, inference,
-                        io, metrics, models, nn, observability, ops,
-                        optimizer, parallel, profiler, resilience, train,
-                        trainer)
+from paddle_tpu import (amp, analysis, config, core, data, debug, fleet,
+                        inference, io, metrics, models, nn, observability,
+                        ops, optimizer, parallel, profiler, resilience,
+                        train, trainer)
 from paddle_tpu.trainer import Trainer
 from paddle_tpu.config import global_config, set_flags
 from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
@@ -19,10 +19,10 @@ from paddle_tpu.executor import CompiledProgram, Executor, Program
 from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
 
 __all__ = [
-    "__version__", "amp", "config", "core", "data", "debug", "fleet",
-    "inference", "io", "metrics", "models", "nn", "observability", "ops",
-    "optimizer", "parallel", "profiler", "resilience", "train", "trainer",
-    "Trainer",
+    "__version__", "amp", "analysis", "config", "core", "data", "debug",
+    "fleet", "inference", "io", "metrics", "models", "nn", "observability",
+    "ops", "optimizer", "parallel", "profiler", "resilience", "train",
+    "trainer", "Trainer",
     "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
     "CompiledProgram", "Executor", "Program",
     "build_eval_step", "build_train_step", "make_train_state",

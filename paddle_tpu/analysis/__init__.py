@@ -1,0 +1,37 @@
+"""Static analysis subsystem: graph lint for TPU-native step functions.
+
+The reference framework finds every hazard at *runtime* (per-op
+``FLAGS_check_nan_inf`` guards, operator.cc:35); on TPU the expensive
+failure modes — host syncs in the step loop, accidental f64, undonated
+buffers doubling peak HBM, reused PRNG keys, replicated multi-GB params
+— are all statically visible in the traced jaxpr before a single step
+runs. This package is the ahead-of-time complement to the observability
+subsystem's runtime ``RecompileDetector``:
+
+- :mod:`~paddle_tpu.analysis.jaxpr_lint` — walks the closed jaxpr
+  (through pjit/scan/while/cond) for host callbacks, f64 promotions,
+  missed donation, PRNG key reuse, and plan-degenerate replication.
+- :mod:`~paddle_tpu.analysis.ast_lint` — reads step-function source for
+  host-sync idioms (``.item()``, ``np.asarray``, ``time.time()``, stdlib
+  ``random``) and Python branches on tracer values.
+- :mod:`~paddle_tpu.analysis.findings` — the reporting spine: structured
+  :class:`Finding` records, text/JSON rendering, registry counting, and
+  committed :class:`Suppressions` for CI.
+
+Entry points: :func:`lint_fn` / :func:`lint_train_step` here,
+``Trainer.fit(lint='warn'|'error'|'off')``, ``Executor(lint=...)``, and
+the ``tools/graph_lint.py`` CLI over the model zoo.
+"""
+
+from paddle_tpu.analysis.api import (LINT_MODES, LintError, abstractify,
+                                     enforce, lint_fn, lint_train_step)
+from paddle_tpu.analysis.ast_lint import lint_callable, lint_source
+from paddle_tpu.analysis.findings import (RULES, SEVERITIES, Finding,
+                                          Report, Suppressions)
+from paddle_tpu.analysis.jaxpr_lint import analyze_jaxpr
+
+__all__ = [
+    "LINT_MODES", "LintError", "RULES", "SEVERITIES", "Finding", "Report",
+    "Suppressions", "abstractify", "analyze_jaxpr", "enforce",
+    "lint_callable", "lint_fn", "lint_source", "lint_train_step",
+]

@@ -1,0 +1,166 @@
+"""Entry points: lint a step function ahead of time.
+
+``lint_fn(fn, *abstract_args, **abstract_kwargs)`` traces ``fn`` with
+abstract values (no compile, no execute) and runs the jaxpr analyzer +
+AST linter; ``lint_train_step`` wraps the framework's ``step(state,
+**batch)`` convention (used by ``Trainer.fit(lint=...)`` and the
+Executor's compile-time hook); ``enforce`` turns a report into warnings
+or a :class:`LintError` per the requested mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from paddle_tpu.analysis import ast_lint, jaxpr_lint
+from paddle_tpu.analysis.findings import Finding, Report, Suppressions
+
+LINT_MODES = ("off", "warn", "error")
+
+
+class LintError(RuntimeError):
+    """Raised by ``enforce`` when a lint report fails in 'error' mode."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__("static analysis failed:\n" + report.render_text())
+
+
+def abstractify(tree: Any) -> Any:
+    """Concrete array pytree -> ShapeDtypeStruct pytree (pass-through for
+    leaves that are already abstract)."""
+    def one(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _donation_flags(fn, args, kwargs, donate_argnums):
+    """Per-flat-input donation flags, or None when undeterminable.
+
+    Explicit ``donate_argnums`` wins; otherwise a jit-wrapped ``fn``
+    reports its own flags through ``Lowered.args_info``."""
+    if donate_argnums is not None:
+        if isinstance(donate_argnums, int):
+            donate_argnums = (donate_argnums,)
+        flags = []
+        for i, a in enumerate(args):
+            n = len(jax.tree_util.tree_leaves(a))
+            flags.extend([i in donate_argnums] * n)
+        for _, v in sorted(kwargs.items()):
+            flags.extend([False] * len(jax.tree_util.tree_leaves(v)))
+        return flags
+    if hasattr(fn, "lower"):
+        try:
+            info = fn.lower(*args, **kwargs).args_info
+            return [a.donated for a in jax.tree_util.tree_leaves(info)]
+        except Exception:
+            return None
+    return None
+
+
+def lint_fn(fn, *args,
+            donate_argnums=None,
+            donated=None,
+            plan=None,
+            state_argnum: Optional[int] = 0,
+            name: Optional[str] = None,
+            ast: bool = True,
+            ast_fn=None,
+            suppressions: Optional[Suppressions] = None,
+            donation_min_bytes: int = 1 << 16,
+            replicated_min_bytes: int = 1 << 20,
+            registry: bool = True,
+            **kwargs) -> Report:
+    """Statically lint ``fn(*args, **kwargs)``; returns a :class:`Report`.
+
+    ``args``/``kwargs`` are example or abstract inputs (arrays and
+    ``jax.ShapeDtypeStruct`` both work — everything is abstracted before
+    tracing, so nothing executes). ``donate_argnums`` feeds the donation
+    rule (a jit-wrapped ``fn`` reports its own donation flags, so it is
+    usually unnecessary). ``plan`` (a ``parallel.plan.ShardingPlan``)
+    enables the replicated-large check against the argument at
+    ``state_argnum``. ``ast=False`` skips the source linter; ``ast_fn``
+    lints a different function's source than the traced one (used when
+    ``fn`` is an adapter closure around the real user step). Findings
+    are counted into the observability registry unless
+    ``registry=False``.
+    """
+    args = tuple(abstractify(a) for a in args)
+    kwargs = {k: abstractify(v) for k, v in kwargs.items()}
+    name = name or getattr(fn, "__name__", None) or type(fn).__name__
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+
+    # invar -> human label ("args[0]['params']['w']")
+    flat, _ = jax.tree_util.tree_flatten_with_path((args, kwargs))
+    labels = [jax.tree_util.keystr(p) for p, _ in flat]
+    arg_labels = list(zip(closed.jaxpr.invars, labels))
+
+    if donated is None:
+        donated = _donation_flags(fn, args, kwargs, donate_argnums)
+    state_tree = None
+    if plan is not None and state_argnum is not None \
+            and state_argnum < len(args):
+        state_tree = args[state_argnum]
+
+    report = Report(name, suppressions=suppressions)
+    report.extend(jaxpr_lint.analyze_jaxpr(
+        closed, name=name, arg_labels=arg_labels, donated=donated,
+        donation_min_bytes=donation_min_bytes, plan=plan,
+        state_tree=state_tree, replicated_min_bytes=replicated_min_bytes))
+    if ast:
+        report.extend(ast_lint.lint_callable(ast_fn or fn))
+    if registry:
+        report.count_into_registry()
+    return report
+
+
+def lint_train_step(step, state, batch, *, plan=None, **kw) -> Report:
+    """Lint a ``step(state, **batch) -> (state, metrics)`` function with
+    this framework's train-step calling convention (arg 0 is the donated
+    state; the batch feeds as keyword arrays). Batch keys are passed
+    through an adapter closure, so they can never collide with lint
+    options; the AST pass still reads the real step's source, and a
+    jit-wrapped step still reports its own donation flags."""
+    state = abstractify(state)
+    batch = {k: abstractify(v) for k, v in batch.items()}
+
+    def _kw_step(state, batch):
+        return step(state, **batch)
+
+    donated = kw.pop("donated", None)
+    if donated is None:
+        # flag extraction runs against the REAL step (the adapter has no
+        # .lower); jit flattens ((state,), batch-kwargs) to the same leaf
+        # order as our positional (state, batch)
+        donated = _donation_flags(step, (state,), batch, None)
+    return lint_fn(_kw_step, state, batch, plan=plan, donated=donated,
+                   ast_fn=step,
+                   name=kw.pop("name", None) or getattr(
+                       step, "__name__", "train_step"), **kw)
+
+
+def enforce(report: Report, mode: str, *, log_fn=None):
+    """Apply a lint mode: 'off' ignores, 'warn' logs every finding,
+    'error' additionally raises :class:`LintError` when any
+    error-severity finding survives suppression. Returns the report."""
+    if mode not in LINT_MODES:
+        raise ValueError(f"lint mode must be one of {LINT_MODES}, "
+                         f"got {mode!r}")
+    if mode == "off" or not len(report):
+        return report
+    text = report.render_text()
+    if log_fn is not None:
+        log_fn(text)
+    else:
+        import warnings
+        warnings.warn(text, stacklevel=3)
+    if mode == "error" and not report.ok("error"):
+        raise LintError(report)
+    return report

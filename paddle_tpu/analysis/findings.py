@@ -1,0 +1,201 @@
+"""Finding records, reports, and suppressions — the lint reporting spine.
+
+Every analysis engine (the jaxpr analyzer, the AST linter) emits
+:class:`Finding` records into one :class:`Report`; the report renders as
+text or JSON, counts findings into the observability registry
+(``analysis_findings_total{rule,severity}``), and applies a committed
+:class:`Suppressions` file so known-accepted warnings don't fail CI.
+
+Reference mapping: the reference framework's correctness tooling is all
+*runtime* (``FLAGS_check_nan_inf`` re-validates every op output as it
+executes, operator.cc:35); this is the static half — hazards visible in
+the traced program are reported before a step runs, with the same
+"rule id + location + hint" shape as compiler diagnostics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("info", "warning", "error")
+
+#: rule id -> (severity, one-line description) — the registry of every
+#: rule either engine can emit; docs and the CLI ``--list-rules`` read it.
+RULES = {
+    "host-callback": (
+        "error",
+        "pure_callback/io_callback in the traced step: every call is a "
+        "device->host->device round trip on the hot path"),
+    "debug-callback": (
+        "warning",
+        "debug_callback (jax.debug.print/callback) in the traced step: "
+        "fine for debugging, a host sync in production"),
+    "f64-promotion": (
+        "warning",
+        "float64/complex128 values in the traced step: TPUs emulate f64 "
+        "(~10x slow); usually an accidental weak-type promotion"),
+    "undonated-buffer": (
+        "warning",
+        "large input buffers with same-shape outputs are not donated: "
+        "peak HBM holds old+new copies of the state"),
+    "prng-key-reuse": (
+        "error",
+        "one PRNG key feeds >=2 random draws with no split/fold_in "
+        "between: the draws are correlated (identical streams)"),
+    "replicated-large": (
+        "warning",
+        "large array replicated on every device under the given sharding "
+        "plan: HBM cost is multiplied by the mesh size"),
+    "ast-host-sync": (
+        "warning",
+        "host-sync Python call (.item()/float()/np.asarray/time.time()/"
+        "stdlib random) inside jit-reachable code"),
+    "ast-tracer-branch": (
+        "error",
+        "Python if/while on a tracer value inside jit-reachable code: "
+        "trace-time crash (ConcretizationTypeError) or silent retrace"),
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: what rule fired, where, and how to fix it."""
+
+    rule: str                 # key into RULES
+    severity: str             # info|warning|error
+    message: str              # specific to this site
+    location: str = ""        # "eqn[3/0] pure_callback" or "file.py:42"
+    fix: str = ""             # actionable hint
+    engine: str = "jaxpr"     # jaxpr | ast | plan
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in "
+                             f"{SEVERITIES}")
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f" @ {self.location}" if self.location else ""
+        hint = f"\n      fix: {self.fix}" if self.fix else ""
+        return (f"  [{self.severity.upper():7s}] {self.rule}{loc}\n"
+                f"      {self.message}{hint}")
+
+
+class Suppressions:
+    """Committed allow-list of known-accepted findings.
+
+    File format, one entry per line::
+
+        # comment
+        <rule-id>  <substring matched against "name location message">
+
+    A ``*`` substring (or none) suppresses every site of the rule.
+    """
+
+    def __init__(self, entries: Sequence[Tuple[str, str]] = ()):
+        self.entries = list(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Suppressions":
+        entries = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(None, 1)
+                rule = parts[0]
+                pat = parts[1].strip() if len(parts) > 1 else "*"
+                entries.append((rule, pat))
+        return cls(entries)
+
+    def matches(self, context: str, finding: Finding) -> bool:
+        hay = f"{context} {finding.location} {finding.message}"
+        for rule, pat in self.entries:
+            if rule == finding.rule and (pat == "*" or pat in hay):
+                return True
+        return False
+
+
+class Report:
+    """Findings for one linted function, with rendering + registry hooks."""
+
+    def __init__(self, name: str = "fn",
+                 findings: Iterable[Finding] = (),
+                 suppressions: Optional[Suppressions] = None):
+        self.name = name
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self._suppressions = suppressions
+        for f in findings:
+            self.add(f)
+
+    def add(self, finding: Finding):
+        if self._suppressions is not None and \
+                self._suppressions.matches(self.name, finding):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]):
+        for f in findings:
+            self.add(f)
+
+    # -- queries ------------------------------------------------------------
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return self.by_severity("warning")
+
+    def ok(self, fail_on: str = "error") -> bool:
+        """True when no finding is at/above ``fail_on`` severity."""
+        bad = SEVERITIES[SEVERITIES.index(fail_on):]
+        return not any(f.severity in bad for f in self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    # -- rendering ----------------------------------------------------------
+    def render_text(self) -> str:
+        lines = [f"graph lint: {self.name} — {len(self.findings)} finding"
+                 f"{'s' if len(self.findings) != 1 else ''}"
+                 + (f" ({len(self.suppressed)} suppressed)"
+                    if self.suppressed else "")]
+        order = {s: i for i, s in enumerate(reversed(SEVERITIES))}
+        for f in sorted(self.findings, key=lambda f: order[f.severity]):
+            lines.append(f.render())
+        return "\n".join(lines)
+
+    def render_json(self) -> str:
+        return json.dumps({
+            "name": self.name,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [f.as_dict() for f in self.suppressed],
+        }, indent=1)
+
+    # -- observability ------------------------------------------------------
+    def count_into_registry(self, reg=None):
+        """One ``analysis_findings_total{rule,severity}`` bump per finding
+        (+ an ``analysis_lint_runs_total`` bump per report)."""
+        from paddle_tpu import observability
+        reg = reg or observability.default()
+        reg.counter("analysis_lint_runs_total",
+                    "static-analysis reports produced").inc()
+        for f in self.findings:
+            reg.counter("analysis_findings_total",
+                        "static-analysis findings by rule/severity").inc(
+                            rule=f.rule, severity=f.severity)
+        return self

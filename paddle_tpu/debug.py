@@ -15,6 +15,7 @@ pin reductions. TPU-native:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 import jax
@@ -23,8 +24,27 @@ from jax.experimental import checkify
 
 
 def enable_nan_checks(enable: bool = True):
-    """Global NaN trap (FLAGS_check_nan_inf parity)."""
+    """Global NaN trap (FLAGS_check_nan_inf parity). Mutates global jax
+    config with no memory of the prior value — prefer the
+    :func:`nan_checks` context manager for scoped use."""
     jax.config.update("jax_debug_nans", enable)
+
+
+@contextlib.contextmanager
+def nan_checks(enable: bool = True):
+    """Scoped NaN trap: enables (or disables) ``jax_debug_nans`` for the
+    block and restores the PRIOR value on exit — nests correctly, unlike
+    :func:`enable_nan_checks` which leaves the flag flipped::
+
+        with debug.nan_checks():
+            loss = step(state, **batch)   # raises on NaN/Inf outputs
+    """
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
 
 
 def check_numerics(tree: Any, label: str = "tensor") -> Any:

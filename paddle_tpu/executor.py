@@ -141,10 +141,13 @@ class Executor:
     mesh's job.
     """
 
-    def __init__(self, place=None, mesh: Optional[Mesh] = None):
+    def __init__(self, place=None, mesh: Optional[Mesh] = None,
+                 lint: str = "off"):
         self.place = place
         self.mesh = mesh
+        self.lint = lint
         self._cache: Dict[int, tuple] = {}
+        self._linted: set = set()
 
     def run(self, program, state=None, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence[str]] = None, return_numpy=True):
@@ -163,6 +166,14 @@ class Executor:
                             ).inc(name=program.name)
             cached_prog, compiled = self._cache[key]
             assert cached_prog is program
+            if self.lint != "off" and key not in self._linted \
+                    and state is not None:
+                # compile-time hook: lint once per Program, against the
+                # first run's avals (abstract tracing, nothing executes).
+                # Marked linted only AFTER enforcement: a caught LintError
+                # must not disarm the gate for the next run.
+                self._lint(program, state, feed)
+                self._linted.add(key)
         else:
             compiled = program
         t0 = time.perf_counter()
@@ -180,6 +191,17 @@ class Executor:
         if return_numpy:
             fetches = jax.tree_util.tree_map(np.asarray, jax.device_get(fetches))
         return state, fetches
+
+    def _lint(self, program: Program, state, feed):
+        """Static analysis of ``program.fn`` (``paddle_tpu.analysis``)
+        before its first dispatch; ``lint='warn'`` warns, ``'error'``
+        raises :class:`~paddle_tpu.analysis.LintError` on error-severity
+        findings. Donation flags come from ``program.donate_state``."""
+        from paddle_tpu import analysis
+        report = analysis.lint_train_step(
+            program.fn, state, feed, name=program.name,
+            donate_argnums=(0,) if program.donate_state else ())
+        analysis.enforce(report, self.lint)
 
     def train_from_dataset(self, program, dataset, state, *,
                            batch_size=64, epochs=1, feed_builder=None,

@@ -135,10 +135,15 @@ class CheckpointManager:
         self._last_saved = step
         return True
 
-    def restore(self, step: Optional[int] = None, target: Optional[Any] = None):
+    def restore(self, step: Optional[int] = None, target: Optional[Any] = None,
+                shardings: Optional[Any] = None):
         """Load the newest VALID snapshot (or ``step``), as host numpy
-        trees; integrity is verified before any bytes are trusted."""
-        return self.manager.restore(step, target=target)
+        trees; integrity is verified before any bytes are trusted.
+        ``shardings`` (pytree of ``jax.sharding.Sharding``) switches to
+        the sharded read path: only this host's addressable shard slices
+        are materialized, directly onto device placements."""
+        return self.manager.restore(step, target=target,
+                                    shardings=shardings)
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()

@@ -437,18 +437,26 @@ class SnapshotEngine:
         m = self.latest_valid_manifest()
         return None if m is None else int(m["step"])
 
-    def restore(self, step: Optional[int] = None, *, target: Any = None):
-        """Load a snapshot into a host-numpy pytree. ``step=None`` takes
-        the newest valid one (falling back past corrupt saves); an
-        explicit ``step`` is verified and REFUSED if corrupted. With
-        ``target``, key/shape agreement is enforced first.
+    def restore(self, step: Optional[int] = None, *, target: Any = None,
+                shardings: Any = None):
+        """Load a snapshot. ``step=None`` takes the newest valid one
+        (falling back past corrupt saves); an explicit ``step`` is
+        verified and REFUSED if corrupted. With ``target``, key/shape
+        agreement is enforced first.
 
-        Scale note: every host reads ALL shard files and assembles the
-        FULL global array per leaf — the 1/H-bytes-per-host win currently
-        applies to the write path only. Restoring each host's shards
-        directly onto device placements (skipping the global assembly,
-        for models that only fit sharded) is a known open item
-        (ROADMAP)."""
+        Without ``shardings``: host-numpy pytree, every leaf assembled
+        to its FULL global shape (fine for models that fit in host RAM).
+
+        With ``shardings`` (a pytree of ``jax.sharding.Sharding`` leaves
+        mirroring the state): the SHARDED restore path — each leaf is
+        materialized only as the shard regions this host's addressable
+        devices need, placed straight onto them, and stitched into a
+        global ``jax.Array`` via ``make_array_from_single_device_arrays``
+        — no full-tree host assembly, so a model that only fits in RAM
+        when sharded restores at ~1/H bytes per host (the read-path twin
+        of the 1/H write path). Leaves whose sharding entry is None fall
+        back to full host assembly. ``resilience_restore_max_region_bytes``
+        gauges the largest single host allocation either path made."""
         t0 = time.perf_counter()
         if step is None:
             manifest = self.latest_valid_manifest()
@@ -458,44 +466,96 @@ class SnapshotEngine:
         else:
             manifest = self._load_manifest(step)  # raises on corruption
         sdir = self._step_dir(step)
-        assembled: Dict[str, List[Tuple[tuple, np.ndarray]]] = {}
-        shapes: Dict[str, tuple] = {}
+        shapes = {k: tuple(v["shape"])
+                  for k, v in manifest["tree"].items()}
+        if target is not None:
+            self._check_target(target, shapes)
+        flat_sh: Dict[str, Any] = {}
+        if shardings is not None:
+            flat_sh = {k: v for k, v in flatten_tree(shardings).items()
+                       if hasattr(v, "addressable_devices")}
+        # required regions per leaf: {key: {region_idx: [devices]}}
+        # (no shardings => one full-shape region, no devices)
+        needed: Dict[str, Dict[tuple, list]] = {}
+        for key, shape in shapes.items():
+            sh = flat_sh.get(key)
+            if sh is None:
+                full = tuple((0, d) for d in shape)
+                needed[key] = {full: []}
+            else:
+                regions: Dict[tuple, list] = {}
+                imap = sh.addressable_devices_indices_map(shape)
+                for dev, idx in imap.items():
+                    regions.setdefault(_norm_index(idx, shape),
+                                       []).append(dev)
+                needed[key] = regions
+        # stream shard files once, copying only intersecting slices into
+        # lazily-allocated region buffers
+        bufs: Dict[Tuple[str, tuple], np.ndarray] = {}
+        max_region = 0
         for fname in manifest["files"]:
             with self.fs.open_read(os.path.join(sdir, fname)) as f:
                 part = pickle.loads(f.read())
             for key, rec in part["leaves"].items():
-                shapes[key] = tuple(rec["shape"])
-                assembled.setdefault(key, []).extend(rec["shards"])
+                for region in needed.get(key, ()):
+                    for idx, data in rec["shards"]:
+                        buf = bufs.get((key, region))
+                        if buf is None:
+                            if idx == region:
+                                # stored slice IS the region: alias it,
+                                # no allocation or copy
+                                bufs[(key, region)] = data
+                                max_region = max(max_region, data.nbytes)
+                                continue
+                            rshape = tuple(b - a for a, b in region)
+                            buf = np.empty(rshape, dtype=data.dtype)
+                            bufs[(key, region)] = buf
+                            max_region = max(max_region, buf.nbytes)
+                        elif not buf.flags.writeable:
+                            # aliased pickle-backed arrays are read-only
+                            if idx == region:
+                                continue     # duplicate full replica
+                            buf = bufs[(key, region)] = np.array(buf)
+                        _copy_overlap(buf, region, idx, data)
         flat = {}
-        for key, shards in assembled.items():
-            shape = shapes[key]
-            if len(shards) == 1 and _covers_all(shards[0][0], shape):
-                flat[key] = shards[0][1]
+        for key, regions in needed.items():
+            sh = flat_sh.get(key)
+            if sh is None:
+                (region,) = regions
+                flat[key] = bufs[(key, region)]
                 continue
-            out = np.empty(shape, dtype=shards[0][1].dtype)
-            for idx, data in shards:
-                out[tuple(slice(a, b) for a, b in idx)] = data
-            flat[key] = out
-        if target is not None:
-            tflat = flatten_tree(target)
-            missing = set(tflat) - set(flat)
-            extra = set(flat) - set(tflat)
-            if missing or extra:
-                raise SnapshotError(
-                    f"snapshot/target mismatch: missing={sorted(missing)[:5]}"
-                    f" extra={sorted(extra)[:5]}")
-            for k, v in tflat.items():
-                if hasattr(v, "shape") and tuple(np.shape(flat[k])) != \
-                        tuple(v.shape):
-                    raise SnapshotError(
-                        f"shape mismatch for {k}: {np.shape(flat[k])} vs "
-                        f"{v.shape}")
+            import jax
+            pieces = []
+            for region, devs in regions.items():
+                buf = bufs[(key, region)]
+                pieces.extend(jax.device_put(buf, d) for d in devs)
+            flat[key] = jax.make_array_from_single_device_arrays(
+                shapes[key], sh, pieces)
         tree = unflatten_tree(flat)
+        observability.gauge(
+            "resilience_restore_max_region_bytes",
+            "largest single host allocation the last restore made"
+        ).set(float(max_region))
         observability.histogram(
             "resilience_restore_seconds",
             "verified manifest to assembled host pytree").observe(
                 time.perf_counter() - t0)
         return tree
+
+    def _check_target(self, target: Any, shapes: Dict[str, tuple]):
+        """Key/shape agreement between ``target`` and a manifest's tree
+        schema, BEFORE any shard bytes are read."""
+        tflat = flatten_tree(target)
+        missing = set(tflat) - set(shapes)
+        extra = set(shapes) - set(tflat)
+        if missing or extra:
+            raise SnapshotError(
+                f"snapshot/target mismatch: missing={sorted(missing)[:5]}"
+                f" extra={sorted(extra)[:5]}")
+        for k, v in tflat.items():
+            if hasattr(v, "shape") and shapes[k] != tuple(v.shape):
+                raise SnapshotError(
+                    f"shape mismatch for {k}: {shapes[k]} vs {v.shape}")
 
     # -- lifecycle ----------------------------------------------------------
     def _raise_pending(self):
@@ -518,3 +578,17 @@ class SnapshotEngine:
 
 def _covers_all(idx, shape) -> bool:
     return all(a == 0 and b == d for (a, b), d in zip(idx, shape))
+
+
+def _copy_overlap(dst: np.ndarray, dst_idx, src_idx, data: np.ndarray):
+    """Copy the intersection of a stored slice (``data`` covering
+    ``src_idx`` of the global array) into a region buffer (``dst``
+    covering ``dst_idx``); a no-op when they are disjoint."""
+    sel_dst, sel_src = [], []
+    for (da, db), (sa, sb) in zip(dst_idx, src_idx):
+        lo, hi = max(da, sa), min(db, sb)
+        if lo >= hi:
+            return
+        sel_dst.append(slice(lo - da, hi - da))
+        sel_src.append(slice(lo - sa, hi - sa))
+    dst[tuple(sel_dst)] = data[tuple(sel_src)]

@@ -102,10 +102,17 @@ class Trainer:
     def fit(self, data_iter: Iterable[Dict[str, Any]], *,
             epochs: int = 1,
             steps_per_epoch: Optional[int] = None,
-            make_iter: Optional[Callable] = None) -> Dict[str, float]:
+            make_iter: Optional[Callable] = None,
+            lint: str = "off") -> Dict[str, float]:
         """Train over batches. ``data_iter`` is an iterable of feed dicts
         (re-created per epoch via ``make_iter`` when given — pass the
-        dataset's ``.batches`` factory for multi-epoch runs)."""
+        dataset's ``.batches`` factory for multi-epoch runs).
+
+        ``lint='warn'|'error'`` statically analyzes the train step against
+        the first batch before any step runs (``paddle_tpu.analysis``:
+        host syncs, f64 promotions, missed donation, PRNG key reuse,
+        tracer branches); ``'warn'`` logs findings, ``'error'`` raises
+        :class:`~paddle_tpu.analysis.LintError` on error-severity ones."""
         if epochs > 1 and make_iter is None and not hasattr(
                 data_iter, "__len__"):
             raise ValueError(
@@ -124,7 +131,8 @@ class Trainer:
         gstep = self.step_count
         try:
             last_metrics = self._fit_epochs(
-                epochs, data_iter, make_iter, steps_per_epoch, tel, gstep)
+                epochs, data_iter, make_iter, steps_per_epoch, tel, gstep,
+                lint=lint)
         finally:
             if tel is not None:
                 tel.close(summary={"metrics": last_metrics})
@@ -140,7 +148,7 @@ class Trainer:
         return last_metrics
 
     def _fit_epochs(self, epochs, data_iter, make_iter, steps_per_epoch,
-                    tel, gstep):
+                    tel, gstep, lint="off"):
         last_metrics: Dict[str, float] = {}
         metrics: Dict[str, Any] = {}
         for epoch in range(epochs):
@@ -153,8 +161,15 @@ class Trainer:
                     batch = next(it)
                 except StopIteration:
                     break
+                data_wait_s = time.perf_counter() - t_fetch
+                if lint != "off" and epoch == 0 and n == 0:
+                    # ahead-of-time gate: abstract tracing only (nothing
+                    # compiles or executes), against the real first batch.
+                    # data_wait was captured above so trace time is not
+                    # booked as an input stall.
+                    self._lint(batch, lint)
                 if tel is not None:
-                    tel.data_wait(time.perf_counter() - t_fetch)
+                    tel.data_wait(data_wait_s)
                 t_step = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, **batch)
                 n += 1
@@ -235,6 +250,14 @@ class Trainer:
             outs.append(jax.device_get(out))   # pytree -> host numpy
         return outs
 
+
+    def _lint(self, batch: Dict[str, Any], mode: str):
+        """Static analysis of the train step against one batch's avals
+        (``paddle_tpu.analysis``); 'warn' logs, 'error' raises."""
+        from paddle_tpu import analysis
+        report = analysis.lint_train_step(self.train_step, self.state,
+                                          batch)
+        analysis.enforce(report, mode, log_fn=self.log_fn)
 
     def _emergency_snapshot(self):
         """Forced synchronous snapshot of the current state (preemption

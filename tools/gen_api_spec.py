@@ -44,6 +44,7 @@ def iter_api():
 
     modules = {
         "paddle_tpu": pt,
+        "paddle_tpu.analysis": pt.analysis,
         "paddle_tpu.nn": pt.nn,
         "paddle_tpu.ops": pt.ops,
         "paddle_tpu.optimizer": pt.optimizer,

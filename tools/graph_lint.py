@@ -1,0 +1,172 @@
+"""Graph lint CLI: static analysis over step functions / the model zoo.
+
+CI self-lint (``tools/run_ci.sh``)::
+
+    python tools/graph_lint.py --preset framework
+
+lints representative zoo step functions — LeNet train step, ResNet-18
+train step, GPT (tiny) cached decode step, and the VGG-style
+ImgConvGroup dropout forward — and exits 1 on any unsuppressed
+error-severity finding. ``tools/graph_lint_suppressions.txt`` is the
+committed allow-list for known-accepted warnings.
+
+Everything here is abstract tracing: no weights are trained, nothing is
+compiled or executed, so the whole preset runs in seconds on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu import analysis  # noqa: E402
+
+DEFAULT_SUPPRESSIONS = os.path.join(os.path.dirname(__file__),
+                                    "graph_lint_suppressions.txt")
+
+
+def _train_step_report(model, loss_fn, sample_batch, *, name,
+                       suppressions, lr=1e-3):
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.train import build_train_step, make_train_state
+
+    optim = opt.Adam(learning_rate=lr)
+    state = make_train_state(model, optim, jax.random.PRNGKey(0))
+    step = jax.jit(build_train_step(loss_fn, optim), donate_argnums=0)
+    return analysis.lint_train_step(step, state, sample_batch, name=name,
+                                    suppressions=suppressions)
+
+
+def lint_lenet(suppressions):
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.ops import nn as F
+
+    model = LeNet()
+
+    def loss_fn(params, image, label):
+        logits = model(params, image)
+        return jnp.mean(F.softmax_with_cross_entropy(logits, label))
+
+    batch = {"image": jnp.zeros((8, 28, 28, 1), jnp.float32),
+             "label": jnp.zeros((8, 1), jnp.int32)}
+    return _train_step_report(model, loss_fn, batch, name="lenet_train",
+                              suppressions=suppressions)
+
+
+def lint_resnet18(suppressions):
+    from paddle_tpu.models import ResNet
+    from paddle_tpu.ops import nn as F
+
+    model = ResNet(depth=18, num_classes=10, in_ch=3)
+
+    def loss_fn(params, image, label):
+        logits = model(params, image, training=True)
+        return jnp.mean(F.softmax_with_cross_entropy(logits, label))
+
+    batch = {"image": jnp.zeros((4, 64, 64, 3), jnp.float32),
+             "label": jnp.zeros((4, 1), jnp.int32)}
+    return _train_step_report(model, loss_fn, batch,
+                              name="resnet18_train",
+                              suppressions=suppressions)
+
+
+def lint_gpt_decode(suppressions):
+    """Cached single-token decode step, jitted WITHOUT cache donation —
+    the undonated-cache warning this produces is a known-accepted entry
+    in the suppression file (``generate()`` donates at its own jit
+    boundary; a bare decode step kept for interactive use cannot, since
+    callers may replay from an old cache)."""
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    cache = model.init_cache(8, 256)     # serving-sized KV cache
+
+    decode = jax.jit(model.decode_step)
+    report = analysis.lint_fn(
+        decode, analysis.abstractify(params),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        analysis.abstractify(cache),
+        name="gpt_decode", ast_fn=model.decode_step,
+        suppressions=suppressions)
+    return report
+
+
+def lint_convgroup(suppressions):
+    """VGG building block with per-layer fold_in dropout keys — the PRNG
+    hygiene surface (must stay key-reuse clean)."""
+    from paddle_tpu.nn import ImgConvGroup
+
+    model = ImgConvGroup(3, [8, 8], pool_size=2, conv_with_batchnorm=True,
+                         conv_batchnorm_drop_rate=0.3, conv_act="relu")
+    params = model.init(jax.random.PRNGKey(0))
+
+    def fwd(params, key, x):
+        return model(params, x, training=True, dropout_key=key).sum()
+
+    return analysis.lint_fn(
+        fwd, analysis.abstractify(params),
+        jax.random.PRNGKey(1),
+        jax.ShapeDtypeStruct((2, 16, 16, 3), jnp.float32),
+        name="vgg_convgroup", suppressions=suppressions)
+
+
+PRESETS = {
+    "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
+                  lint_convgroup],
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--preset", choices=sorted(PRESETS),
+                    default="framework",
+                    help="which set of zoo step functions to lint")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="error",
+                    help="exit 1 when any unsuppressed finding is at or "
+                         "above this severity")
+    ap.add_argument("--suppressions", default=DEFAULT_SUPPRESSIONS,
+                    help="suppression file (rule-id + substring per line);"
+                         " 'none' disables")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON report per model instead of text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (sev, desc) in sorted(analysis.RULES.items()):
+            print(f"{rule:20s} [{sev}] {desc}")
+        return 0
+
+    sup = None
+    if args.suppressions and args.suppressions != "none" and \
+            os.path.exists(args.suppressions):
+        sup = analysis.Suppressions.load(args.suppressions)
+
+    rc = 0
+    for build in PRESETS[args.preset]:
+        report = build(sup)
+        print(report.render_json() if args.json else report.render_text())
+        if not report.ok(args.fail_on):
+            rc = 1
+    if rc:
+        print(f"graph lint FAILED (findings at >= {args.fail_on} "
+              "severity; see above)", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,13 @@ $PYTEST tests/ -m "not slow"
 echo "== bench smoke (int8 dryrun) =="
 python tools/int8_bench.py --dryrun > /dev/null
 
+# static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
+# decode, VGG conv-group dropout) must be free of error-severity graph
+# hazards (host syncs, key reuse, tracer branches); accepted warnings
+# live in tools/graph_lint_suppressions.txt
+echo "== graph self-lint (framework preset) =="
+python tools/graph_lint.py --preset framework
+
 if [ "$MODE" = "--quick" ]; then
   echo "CI OK (quick tier)"
   exit 0
