@@ -548,6 +548,253 @@ def run_bench_deepfm(dev):
     }
 
 
+SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
+                  "decode_tokens_per_sec", "baseline_tokens_per_sec",
+                  "speedup_vs_dense_loop", "end_to_end_tokens_per_sec",
+                  "end_to_end_speedup", "decode_seconds_engine",
+                  "decode_seconds_dense", "prefill_seconds_engine",
+                  "prefill_seconds_dense", "ttft_mean_s", "ttft_max_s",
+                  "mean_slot_occupancy", "page_utilization_peak",
+                  "decode_recompiles_after_warmup", "num_requests",
+                  "num_slots", "page_size", "device")
+
+
+def serving_json_path(dryrun: bool) -> str:
+    import os
+    if dryrun:  # CI smoke must not dirty the checkout
+        return os.environ.get("PADDLE_TPU_BENCH_SERVING",
+                              "/tmp/BENCH_SERVING.json")
+    return os.environ.get(
+        "PADDLE_TPU_BENCH_SERVING",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SERVING.json"))
+
+
+def run_bench_serving(dev, dryrun=False):
+    """Continuous-batching serving throughput (ISSUE 4 acceptance): the
+    paged ServingEngine versus looping ``GPT.generate(use_cache=True)``
+    over the SAME requests — mixed prompt lengths, shared decode cap,
+    early-EOS mix. The engine evicts a sequence the step EOS lands and
+    backfills the slot; ``generate``'s fixed-trip device loop cannot
+    stop early (the lock-step waste the ISSUE motivates paging with),
+    so the dense loop burns the full cap on every request. Throughput
+    counts USEFUL tokens (up to EOS — both sides emit identical greedy
+    streams, so useful counts are identical by construction). Random
+    init has no trained stop behavior, so per-request EOS ids are
+    derived from reference rollouts (first occurrence of a real emitted
+    token near a target stop position); ~1/6 of requests get no EOS and
+    run to cap — the long tail. Both sides are warmed (compiles
+    excluded). ``vs_baseline`` is speedup/2.0 — 1.0 == the >=2x target.
+    Emits BENCH_SERVING.json (schema self-validated) next to this file
+    (dryrun: /tmp)."""
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=12,
+                        num_heads=16, ffn_size=4096, max_position=512,
+                        dropout=0.0)
+        n_req, num_slots, page_size, chunk, cap = 48, 16, 16, 64, 96
+        len_set = (16, 32, 48, 64, 96, 128, 192, 256)
+        attn_impl = "pallas"
+    elif dryrun:
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=32, num_layers=2,
+                             num_heads=2, ffn_size=64, max_position=64,
+                             dropout=0.0, attn_impl="xla")
+        n_req, num_slots, page_size, chunk, cap = 6, 4, 4, 8, 8
+        len_set = (4, 9, 17, 24)
+        attn_impl = "lax"
+    else:
+        # CPU measurement config: weight-heavy (LLM decode is weight-
+        # bound — params >> per-step KV traffic) so batching amortizes
+        # weight reads the way real serving does; bf16 KV pages on both
+        # sides (generate gets cache_dtype too). Prompt lengths come
+        # from a small bucket set, as a shape-bucketing front end would
+        # deliver them.
+        cfg = GPTConfig(vocab_size=1024, hidden_size=512, num_layers=6,
+                        num_heads=8, ffn_size=2048, max_position=320,
+                        dropout=0.0, attn_impl="xla")
+        n_req, num_slots, page_size, chunk, cap = 32, 8, 16, 64, 64
+        len_set = (16, 32, 48, 64, 96, 128, 192, 256)
+        attn_impl = "lax"
+
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    lens = rng.choice(len_set, n_req)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+               for n in lens]
+    lo, hi = min(len_set), max(len_set)
+    cache_dtype = jnp.bfloat16 if not on_tpu else None
+
+    reg = obs.MetricsRegistry()
+    eng = serving.ServingEngine(
+        model, params, num_slots=num_slots, page_size=page_size,
+        max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
+        attn_impl=attn_impl, cache_dtype=cache_dtype, registry=reg)
+    # startup compiles happen here (every gather bucket + the prefill
+    # chunk), so everything timed below is steady-state serving
+    eng.warmup()
+
+    # reference prefixes (also an engine warm pass): early stops land in
+    # the first few tokens of a greedy stream, so a short prefix rollout
+    # is enough to pick each request's EOS id
+    ref_new = min(16, cap)
+    streams = eng.generate_many(prompts, ref_new, max_steps=1_000_000)
+    eos_ids = []
+    useful = []
+    for i, t in enumerate(streams):
+        if i % 6 == 0:          # the no-EOS long tail: run to cap
+            eos_ids.append(None)
+            useful.append(cap)
+            continue
+        target = int(rng.integers(2, ref_new))
+        first = {}              # token -> first-occurrence index
+        for j, tok in enumerate(t.tolist()):
+            first.setdefault(tok, j)
+        tok, j = min(first.items(), key=lambda kv: abs(kv[1] - target))
+        eos_ids.append(int(tok))
+        useful.append(j + 1)
+    total_useful = int(sum(useful))
+
+    det = obs.RecompileDetector("serving_bench", warmup=0, registry=reg)
+
+    def engine_pass():
+        for m in ("serving_ttft_seconds", "serving_queue_wait_seconds",
+                  "serving_decode_step_seconds",
+                  "serving_prefill_seconds"):
+            reg.unregister(m)   # this pass's samples only
+        occ = []
+        peak_util = 0.0
+        rids = [eng.submit(p, cap, eos_id=e)
+                for p, e in zip(prompts, eos_ids)]
+        t0 = time.perf_counter()
+        while not eng.scheduler.idle():
+            eng.step()
+            # the gauges hold occupancy/utilization as the decode batch
+            # ran (pre-eviction); the cache itself is already drained
+            occ.append(reg.gauge("serving_slot_occupancy").value())
+            peak_util = max(peak_util,
+                            reg.gauge("serving_page_utilization").value())
+        dt = time.perf_counter() - t0
+        for r, u in zip(rids, useful):
+            got = eng.result(r)
+            assert got is not None and len(got) == u, \
+                "engine/ref divergence"
+        return {
+            "dt": dt,
+            "decode_s": reg.histogram("serving_decode_step_seconds"
+                                      ).summary()["sum"],
+            "prefill_s": reg.histogram("serving_prefill_seconds"
+                                       ).summary()["sum"],
+            "ttft": reg.histogram("serving_ttft_seconds").summary(),
+            "occ": occ, "peak_util": peak_util,
+        }
+
+    # two passes, best wall-clock kept: a 2-core CI box sees ambient
+    # load spikes that would otherwise masquerade as engine regressions
+    ep = min((engine_pass() for _ in range(2)), key=lambda r: r["dt"])
+    det.check()
+    occ, peak_util, ttft = ep["occ"], ep["peak_util"], ep["ttft"]
+    dt_engine = ep["dt"]
+    eng_decode_s = ep["decode_s"]
+    eng_prefill_s = ep["prefill_s"]
+    engine_tps = total_useful / max(eng_decode_s, 1e-9)
+    engine_e2e = total_useful / dt_engine
+
+    # --- dense loop: same requests through generate(use_cache=True),
+    # one call per request (mixed prompt lengths cannot batch correctly
+    # through a padded lock-step generate). generate has no EOS exit,
+    # so every request decodes the full cap; compile time excluded by a
+    # warmup pass over every shape.
+    def dense_fn(mnew):
+        return jax.jit(lambda pp, ids: model.generate(
+            pp, ids, max_new_tokens=mnew, use_cache=True,
+            cache_dtype=cache_dtype))
+
+    fns, pf_times = {}, {}
+    full = dense_fn(cap)
+    pf = dense_fn(1)   # prefill + one token: the dense prefill cost
+    for p in prompts:
+        if len(p) in fns:
+            continue
+        x = jnp.asarray(p)[None]
+        full(params, x).block_until_ready()         # compile cap graph
+        pf(params, x).block_until_ready()           # compile prefill probe
+        t0 = time.perf_counter()
+        pf(params, x).block_until_ready()
+        pf_times[len(p)] = time.perf_counter() - t0
+        fns[len(p)] = True
+    def dense_pass():
+        t0 = time.perf_counter()
+        for p in prompts:
+            full(params, jnp.asarray(p)[None]).block_until_ready()
+        return time.perf_counter() - t0
+
+    dt_dense = min(dense_pass() for _ in range(2))
+    # decode-phase split: prefill measured per unique prompt length via
+    # the max_new=1 probe (slightly OVERcounts dense prefill — one
+    # decode step rides along — so the reported speedup is conservative)
+    dense_prefill_s = sum(pf_times[len(p)] for p in prompts)
+    dense_decode_s = max(dt_dense - dense_prefill_s, 1e-9)
+    dense_tps = total_useful / dense_decode_s
+    dense_e2e = total_useful / dt_dense
+
+    speedup = engine_tps / max(dense_tps, 1e-9)
+    e2e_speedup = engine_e2e / max(dense_e2e, 1e-9)
+    result = {
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(engine_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(speedup / 2.0, 4),  # 1.0 == the 2x target
+        "decode_tokens_per_sec": round(engine_tps, 2),
+        "baseline_tokens_per_sec": round(dense_tps, 2),
+        "speedup_vs_dense_loop": round(speedup, 4),
+        "end_to_end_tokens_per_sec": round(engine_e2e, 2),
+        "end_to_end_speedup": round(e2e_speedup, 4),
+        "decode_seconds_engine": round(eng_decode_s, 3),
+        "decode_seconds_dense": round(dense_decode_s, 3),
+        "prefill_seconds_engine": round(eng_prefill_s, 3),
+        "prefill_seconds_dense": round(dense_prefill_s, 3),
+        "ttft_mean_s": round(ttft.get("mean", 0.0), 6),
+        "ttft_max_s": round(ttft.get("max", 0.0), 6),
+        "mean_slot_occupancy": round(float(np.mean(occ)), 4),
+        "page_utilization_peak": round(peak_util, 4),
+        "decode_recompiles_after_warmup": det.recompiles,
+        "num_requests": n_req,
+        "num_slots": num_slots,
+        "page_size": page_size,
+        "decode_cap": cap,
+        "useful_tokens": total_useful,
+        "mean_useful_per_request": round(total_useful / n_req, 2),
+        "prompt_lens": [int(lo), int(hi)],
+        "device": getattr(dev, "device_kind", dev.platform),
+        "dryrun": bool(dryrun),
+        "_telemetry": {"steps": len(occ), "dt": dt_engine,
+                       "examples_per_step": num_slots,
+                       "tokens_per_step": total_useful / max(len(occ), 1)},
+    }
+
+    missing = [k for k in SERVING_SCHEMA if k not in result]
+    if missing:
+        raise RuntimeError(f"BENCH_SERVING schema self-check failed: "
+                           f"missing {missing}")
+    if result["decode_recompiles_after_warmup"] != 0:
+        raise RuntimeError("steady-state serving recompiled "
+                           f"{det.recompiles}x — fixed-shape invariant "
+                           "broken")
+    path = serving_json_path(dryrun)
+    with open(path, "w") as f:
+        json.dump({k: v for k, v in result.items()
+                   if k != "_telemetry"}, f, indent=2)
+    result["bench_json"] = path
+    return result
+
+
 _BENCHES = {
     "bert": (run_bench, "bert_base_tokens_per_sec_per_chip",
              "tokens/s/chip"),
@@ -558,6 +805,8 @@ _BENCHES = {
                     "real tokens/s/chip"),
     "deepfm": (run_bench_deepfm, "deepfm_examples_per_sec_per_chip",
                "examples/s/chip"),
+    "serving": (run_bench_serving, "serving_decode_tokens_per_sec",
+                "tokens/s"),
 }
 
 
@@ -575,7 +824,10 @@ def main():
         from paddle_tpu import observability as obs
         obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
-        result = _BENCHES[which][0](dev)
+        if which == "serving":  # CI smoke: tiny sizes + schema self-check
+            result = run_bench_serving(dev, dryrun="--dryrun" in sys.argv)
+        else:
+            result = _BENCHES[which][0](dev)
         if degraded:  # zero BEFORE telemetry so JSONL/.prom agree with stdout
             result["error"] = degraded
             result["vs_baseline"] = 0.0

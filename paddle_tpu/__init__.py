@@ -11,7 +11,7 @@ from paddle_tpu.version import __version__
 from paddle_tpu import (amp, analysis, config, core, data, debug, fleet,
                         inference, io, metrics, models, nn, observability,
                         ops, optimizer, parallel, profiler, resilience,
-                        train, trainer)
+                        serving, train, trainer)
 from paddle_tpu.trainer import Trainer
 from paddle_tpu.config import global_config, set_flags
 from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
@@ -21,8 +21,8 @@ from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
 __all__ = [
     "__version__", "amp", "analysis", "config", "core", "data", "debug",
     "fleet", "inference", "io", "metrics", "models", "nn", "observability",
-    "ops", "optimizer", "parallel", "profiler", "resilience", "train",
-    "trainer", "Trainer",
+    "ops", "optimizer", "parallel", "profiler", "resilience", "serving",
+    "train", "trainer", "Trainer",
     "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
     "CompiledProgram", "Executor", "Program",
     "build_eval_step", "build_train_step", "make_train_state",

@@ -214,6 +214,20 @@ def load_inference_model(path: str) -> "Predictor":
     return Predictor(path)
 
 
+def make_serving_engine(model, params, **kwargs):
+    """Continuous-batching serving front end for a generative model —
+    the high-QPS sibling of :class:`Predictor` (which serves one
+    exported forward per ``run()``). Builds a
+    :class:`paddle_tpu.serving.ServingEngine` over a paged KV cache:
+    ``submit()`` requests, drive ``step()`` (or ``generate_many``), and
+    the engine keeps its fixed decode slots full — admission into freed
+    slots, immediate EOS eviction, O(live tokens) ragged paged decode
+    attention — while reporting tokens/s, TTFT, slot occupancy and page
+    utilization through the observability registry."""
+    from paddle_tpu import serving as _serving
+    return _serving.ServingEngine(model, params, **kwargs)
+
+
 class Predictor:
     """Zero-copy-ish serving wrapper over an exported model.
 

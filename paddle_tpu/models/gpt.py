@@ -9,6 +9,7 @@ workload for ring attention. Pre-LN, learned positions, tied head.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -268,3 +269,81 @@ class GPT(Layer):
 
         ids, _ = jax.lax.fori_loop(s0, total, body, (ids, key))
         return ids
+
+    # ---- bucketed decoding (recompile cap) ------------------------------
+
+    def _generate_padded_cached(self, params, padded_ids, prompt_len,
+                                max_new_bucket):
+        """Greedy cached decode where the REAL prompt length is a traced
+        scalar: ``padded_ids`` (B, S0b) holds the prompt right-padded to
+        the bucket; prefill seeds the cache causally over the padded
+        buffer, the first token samples from ``prompt_len - 1``, and the
+        decode loop overwrites the pad garbage in cache order (each step
+        masks to ``<= cache_pos``, so garbage K/V past the write head is
+        never attended). Returns generated tokens (B, max_new_bucket)."""
+        b, s0b = padded_ids.shape
+        cache = self.init_cache(b, s0b + max_new_bucket,
+                                dtype=params["wte"]["weight"].dtype)
+        logits, cache = self.prefill(params, padded_ids, cache)
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[None, None, None].astype(jnp.int32)
+            .repeat(b, 0), axis=1)[:, 0]
+        gen = jnp.zeros((b, max_new_bucket), jnp.int32)
+        gen = gen.at[:, 0].set(jnp.argmax(last, -1).astype(jnp.int32))
+
+        def body(t, carry):
+            gen, cache = carry
+            logits, cache = self.decode_step(
+                params, gen[:, t - 1], prompt_len + t - 1, cache)
+            return gen.at[:, t].set(
+                jnp.argmax(logits, -1).astype(jnp.int32)), cache
+
+        gen, _ = jax.lax.fori_loop(1, max_new_bucket, body, (gen, cache))
+        return gen
+
+    def generate_bucketed(self, params, prompt_ids, max_new_tokens=32,
+                          *, min_bucket=8):
+        """Greedy :meth:`generate` with power-of-two shape bucketing:
+        the prompt is right-padded to the next pow2 length and the
+        decode horizon rounded up the same way, so every request whose
+        (prompt, horizon) lands in the same bucket reuses ONE compiled
+        graph — a serving box sees a handful of compiles total instead
+        of one per distinct request shape. Tokens are identical to
+        ``generate(use_cache=True)`` because the real prompt length is a
+        traced scalar (pad K/V is masked, then overwritten). LayerList
+        layout only, greedy only. Returns (B, S0 + max_new_tokens) ids,
+        same contract as :meth:`generate`."""
+        cfg = self.cfg
+        if cfg.pipeline or cfg.stacked_layers:
+            raise ValueError("generate_bucketed needs the LayerList "
+                             "layout (like generate(use_cache=True))")
+        import numpy as np
+        prompt_host = np.asarray(prompt_ids)
+        b, s0 = prompt_host.shape
+
+        def pow2(n):
+            return 1 << max(int(n) - 1, 0).bit_length()
+
+        s0b = min(max(pow2(s0), min_bucket), cfg.max_position)
+        nb = max(pow2(max_new_tokens), min_bucket)
+        if s0 + max_new_tokens > cfg.max_position:
+            raise ValueError("prompt + max_new_tokens exceeds max_position")
+        s0b = max(s0b, s0)  # max_position clamp must never truncate
+        padded = np.zeros((b, s0b), np.int32)
+        padded[:, :s0] = prompt_host
+        jits = getattr(self, "_bucket_jit_cache", None)
+        if jits is None:
+            jits = {}
+            object.__setattr__(self, "_bucket_jit_cache", jits)
+        fn = jits.get((s0b, nb))
+        if fn is None:
+            fn = jax.jit(functools.partial(self._generate_padded_cached,
+                                           max_new_bucket=nb))
+            jits[(s0b, nb)] = fn
+        gen = fn(params, jnp.asarray(padded),
+                 jnp.asarray(s0, jnp.int32))
+        # assemble on host: an eager jnp.concatenate would compile once
+        # per prompt length — exactly the retraces bucketing removes
+        return jnp.asarray(np.concatenate(
+            [prompt_host.astype(np.int32),
+             np.asarray(gen)[:, :max_new_tokens]], axis=1))

@@ -79,6 +79,24 @@ class MultiHeadAttention(Layer):
         b, h, s, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
+    def qkv_heads(self, params, x):
+        """(B, S, D) -> (q, k, v) heads, each (B, H, S, Dh) — the serving
+        engine's hook: it owns the attention itself (ragged paged decode
+        over the shared page pool) and only needs the projections."""
+        if self.self_attention:
+            qkv = self.qkv_proj(params["qkv_proj"], x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = self.q_proj(params["q_proj"], x)
+            kv = self.kv_proj(params["kv_proj"], x)
+            k, v = jnp.split(kv, 2, axis=-1)
+        return tuple(self._split_heads(t) for t in (q, k, v))
+
+    def proj_out(self, params, heads):
+        """(B, H, S, Dh) attention output heads -> (B, S, D) through the
+        output projection (the other half of the serving hook)."""
+        return self.out_proj(params["out_proj"], self._merge_heads(heads))
+
     def cross_kv(self, params, memory):
         """Precompute cross-attention (k, v) heads from encoder memory —
         done ONCE per sequence; decode steps pass them as ``static_kv``

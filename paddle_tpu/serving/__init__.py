@@ -1,0 +1,34 @@
+"""Paged KV-cache serving engine: continuous batching + ragged decode.
+
+The serving-throughput subsystem (ISSUE 4). Four parts:
+
+1. **Paged KV cache** (`paged_cache.py`): K/V in fixed-size pages with
+   per-slot block tables and a host-side allocator — HBM scales with
+   live tokens, not ``batch × max_len``.
+2. **Ragged paged decode attention** (`decode_attention.py`): one
+   fixed-shape kernel call attends every slot's query over only its own
+   live pages (Pallas with block-table scalar prefetch; lax fallback and
+   an ``interpret=True`` path so CPU tier-1 tests run the real kernel).
+3. **Continuous-batching scheduler** (`scheduler.py`): fixed decode
+   slots, FIFO admission into freed slots, immediate eviction on
+   EOS/length cap — pure host logic.
+4. **ServingEngine** (`engine.py`): ``submit``/``step``/
+   ``generate_many`` driving one jit-compiled fixed-shape decode step
+   with donated cache pages (zero steady-state recompiles, proven by a
+   ``RecompileDetector``), wired into the observability registry.
+"""
+
+from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
+                                            PageOverflowError)
+from paddle_tpu.serving.decode_attention import (paged_prefill_attention,
+                                                 ragged_paged_decode_attention)
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          Request, SlotState)
+from paddle_tpu.serving.engine import ServingEngine
+
+__all__ = [
+    "PagedCacheConfig", "PagedKVCache", "PageOverflowError",
+    "paged_prefill_attention", "ragged_paged_decode_attention",
+    "ContinuousBatchingScheduler", "Request", "SlotState",
+    "ServingEngine",
+]

@@ -1,0 +1,232 @@
+"""Ragged paged decode attention: the serving engine's hot kernel.
+
+One fixed-shape call attends every decode slot's single query token over
+only that slot's *live* KV pages — the "Ragged Paged Attention" TPU
+serving pattern (PAPERS.md): sequences of wildly different lengths batch
+into one step, and work/HBM traffic scale with live tokens, not with
+``batch × max_len`` padding.
+
+Layouts
+  q            (S, H, Dh)        one query token per decode slot
+  k/v pages    (P, ps, H, Dh)    fixed-size pages, token-major
+  block_tables (S, max_pages)    page ids per slot (page 0 = null page)
+  lengths      (S,)              live tokens per slot (0 = inactive slot)
+
+Two implementations with identical numerics:
+
+- ``impl="lax"``: XLA gather + masked softmax (CPU/debug reference).
+- ``impl="pallas"`` / ``"pallas_interpret"``: a Pallas kernel, grid
+  ``(S, H, max_pages)``, that scalar-prefetches the block table so each
+  kv block's HBM address is known before the body runs (the
+  PrefetchScalarGridSpec pattern), does online-softmax accumulation over
+  pages, and skips pages past the slot's length entirely. The interpret
+  path runs the REAL kernel on CPU, so tier-1 tests exercise it.
+
+Fully-masked slots (length 0) emit exact zeros on both paths.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU pallas backend (interpret mode still works without a TPU)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from paddle_tpu.ops.attention import NEG_INF
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# lax reference path
+# ---------------------------------------------------------------------------
+
+def _paged_decode_lax(q, k_pages, v_pages, block_tables, lengths, scale):
+    s_slots, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    # contract straight against the gathered 5-D (S, mp, ps, H, Dh)
+    # layout — reshaping the gather to token-major would materialize a
+    # full extra copy of every slot's K and V per call
+    kg = k_pages[block_tables]
+    vg = v_pages[block_tables]
+    scores = jnp.einsum("shd,smthd->shmt", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    scores = scores.reshape(s_slots, h, mp * ps)
+    tok = jnp.arange(mp * ps, dtype=jnp.int32)
+    valid = tok[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    # length-0 slots: every key masked -> emit 0, not a uniform mean of v
+    alive = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0).reshape(s_slots, h, mp, ps)
+    out = jnp.einsum("shmt,smthd->shd", p, vg.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (S, H, max_pages), block-table scalar prefetch
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size):
+    sl = pl.program_id(0)
+    pj = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(pj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[sl]
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)               # (1, Dh)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (1, ps)
+        tok = pj * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(tok < length, s, NEG_INF)
+
+        m_prev = m_scr[...]                            # (1, 128)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)      # (1, 1)
+        m_next = jnp.maximum(m_prev, m_cur)            # lanes broadcast
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])                 # (1, ps)
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (1, Dh)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
+
+    # ragged skip: pages at/after the slot's length hold no live tokens
+    pl.when(pj * page_size < length)(_body)
+
+    @pl.when(pj == npg - 1)
+    def _finish():
+        denom = l_scr[...][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        alive = m_scr[...][:, :1] > NEG_INF / 2
+        o_ref[0] = jnp.where(alive, acc_scr[...] / denom, 0.0).astype(
+            o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
+                         interpret):
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
+    s_slots, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    qs = (q * jnp.asarray(scale, q.dtype))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, lengths
+        grid=(s_slots, h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, dh), lambda s, hh, j, bt, ln: (s, hh, 0)),
+            pl.BlockSpec((1, ps, 1, dh),
+                         lambda s, hh, j, bt, ln: (bt[s, j], 0, hh, 0)),
+            pl.BlockSpec((1, ps, 1, dh),
+                         lambda s, hh, j, bt, ln: (bt[s, j], 0, hh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, dh),
+                               lambda s, hh, j, bt, ln: (s, hh, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, page_size=ps)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, h, dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qs, k_pages, v_pages)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def ragged_paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                  lengths, *, scale: Optional[float] = None,
+                                  impl: str = "auto"):
+    """One decode step of attention for every slot at once.
+
+    ``q`` (S, H, Dh); ``k_pages``/``v_pages`` (P, page_size, H, Dh);
+    ``block_tables`` (S, max_pages) int32; ``lengths`` (S,) int32 valid
+    tokens per slot. Returns (S, H, Dh). ``impl``: "auto" (pallas on
+    TPU, lax elsewhere), "lax", "pallas", "pallas_interpret".
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "auto":
+        impl = "pallas" if (pltpu is not None and _on_tpu()) else "lax"
+    if impl == "lax":
+        return _paged_decode_lax(q, k_pages, v_pages, block_tables,
+                                 lengths, scale)
+    if impl in ("pallas", "pallas_interpret"):
+        return _paged_decode_pallas(q, k_pages, v_pages, block_tables,
+                                    lengths, scale,
+                                    interpret=impl == "pallas_interpret")
+    raise ValueError(f"unknown impl {impl!r}")
+
+
+def paged_prefill_attention(q, k_pages, v_pages, block_table_row,
+                            positions, *, scale: Optional[float] = None):
+    """Chunked-prefill attention for ONE slot.
+
+    ``q`` (C, H, Dh) — a chunk of query tokens at absolute ``positions``
+    (C,) int32; keys/values are read from the slot's pages via
+    ``block_table_row`` (max_pages,). Each query attends causally to all
+    cache positions ``<= positions[c]`` (earlier chunks + the causal
+    prefix of this chunk, whose K/V the caller has already written).
+    Padded queries (positions past the chunk's valid length) produce
+    garbage rows the caller discards. XLA-composed: prefill is a few
+    calls per request, the per-step hot path is the decode kernel.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mp = block_table_row.shape[0]
+    ps = k_pages.shape[1]
+    h, dh = q.shape[1], q.shape[2]
+    k = k_pages[block_table_row].reshape(mp * ps, h, dh)
+    v = v_pages[block_table_row].reshape(mp * ps, h, dh)
+    scores = jnp.einsum("chd,thd->hct", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    tok = jnp.arange(mp * ps, dtype=jnp.int32)
+    causal = tok[None, None, :] <= positions[None, :, None]
+    scores = jnp.where(causal, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    alive = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0)
+    out = jnp.einsum("hct,thd->chd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

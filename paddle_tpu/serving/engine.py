@@ -1,0 +1,403 @@
+"""ServingEngine: continuous-batching GPT inference over a paged KV cache.
+
+The serving loop is ONE jit-compiled fixed-shape decode step — every
+slot advances a BLOCK of ``decode_block`` tokens per call (an on-device
+``fori_loop``, amortizing the host round-trip), attending over its own
+pages via ``decode_attention.ragged_paged_decode_attention`` — plus a
+fixed-shape chunked-prefill step that feeds prompts into freed slots.
+All shapes are static: ``num_slots``, the prefill chunk, and a pow2-
+bucketed block-table gather width that tracks the LIVE high-water mark
+(so decode work follows live tokens, not slot capacity, even on the lax
+fallback). The cache pages are **donated** into both steps, and
+:meth:`ServingEngine.warmup` precompiles every bucket, so steady-state
+serving triggers zero recompiles and zero cache copies — a
+:class:`~paddle_tpu.observability.RecompileDetector` wired to the step
+proves it.
+
+Decode work per block is O(live tokens) — a slot holding a 16-token
+sequence reads 1 page while its neighbour reads 16 — versus the dense
+``generate(use_cache=True)`` loop's O(batch × max_len) padded attention.
+
+Metrics (observability registry): ``serving_requests_total``,
+``serving_tokens_total``, ``serving_prefill_tokens_total``,
+``serving_steps_total``, ``serving_ttft_seconds``,
+``serving_queue_wait_seconds``, ``serving_slot_occupancy``,
+``serving_page_utilization``, plus ``serving_decode_recompiles_total``
+via the detector.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.serving import decode_attention as DA
+from paddle_tpu.serving.paged_cache import PagedCacheConfig, PagedKVCache
+from paddle_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+
+class ServingEngine:
+    """Continuous-batching front end over a ``models.gpt.GPT``.
+
+    ``submit()`` enqueues a request, ``step()`` advances every live slot
+    one token (admitting queued requests into freed slots first), and
+    ``generate_many()`` drives the loop to completion. Decoding is
+    greedy — the deterministic serving mode the paged-vs-dense parity
+    tests pin down.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_tokens_per_slot: Optional[int] = None,
+                 prefill_chunk: int = 32, decode_block: int = 8,
+                 attn_impl: str = "auto", cache_dtype=None,
+                 registry=None):
+        cfg = model.cfg
+        if cfg.pipeline or cfg.stacked_layers:
+            raise ValueError(
+                "ServingEngine needs the LayerList GPT layout; convert "
+                "stacked/pipeline checkpoints for serving first")
+        self.model = model
+        self.params = params
+        self.attn_impl = attn_impl
+        self.prefill_chunk = int(prefill_chunk)
+        self.decode_block = max(int(decode_block), 1)
+        if max_tokens_per_slot is None:
+            max_tokens_per_slot = cfg.max_position
+        max_pages_per_slot = -(-max_tokens_per_slot // page_size)
+        if num_pages is None:
+            # enough for every slot full, +1 null page — callers can size
+            # DOWN to bet on early EOS (that is the paging win)
+            num_pages = num_slots * max_pages_per_slot + 1
+        # like generate(cache_dtype=...): a bf16 page pool halves KV
+        # gather traffic (softmax still runs fp32 inside the kernel)
+        dtype = cache_dtype or params["wte"]["weight"].dtype
+        self.cache = PagedKVCache(PagedCacheConfig(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            num_slots=num_slots, page_size=page_size, num_pages=num_pages,
+            max_pages_per_slot=max_pages_per_slot, dtype=dtype))
+        self.scheduler = ContinuousBatchingScheduler(
+            num_slots, can_admit=self._can_admit)
+
+        from paddle_tpu import observability as obs
+        self._reg = registry or obs.default()
+        self.recompile_detector = obs.RecompileDetector(
+            "serving_decode", warmup=1, registry=self._reg)
+
+        self.decode_step = jax.jit(self._decode_step_impl,
+                                   donate_argnums=(1,))
+        self.prefill_step = jax.jit(self._prefill_chunk_impl,
+                                    donate_argnums=(1,))
+        # finished-request store for result(); pop-on-read + bounded, so
+        # a server that only consumes step()'s return dict still cannot
+        # grow host memory with the total requests ever served
+        self._results: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._results_cap = max(64, 16 * num_slots)
+
+    # -- request surface --------------------------------------------------
+
+    def _can_admit(self, req) -> bool:
+        return self.cache.can_reserve(req.total_tokens)
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None) -> int:
+        total = len(np.asarray(prompt).reshape(-1)) + max_new_tokens
+        limit = min(self.cache.config.max_tokens_per_slot,
+                    self.model.cfg.max_position)
+        if total > limit:
+            raise ValueError(f"request needs {total} tokens > per-slot "
+                             f"limit {limit}")
+        if self.cache.config.pages_for(total) > self.cache.config.num_pages - 1:
+            raise ValueError("request exceeds the whole page pool")
+        rid = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+        self._reg.counter("serving_requests_total",
+                          "requests submitted to the engine").inc()
+        return rid
+
+    def result(self, rid: int) -> Optional[np.ndarray]:
+        """Generated tokens for a finished request (None while running
+        or already consumed). Pop-on-read, and the store keeps only the
+        most recent finishers (``step()``'s return dict is the primary
+        delivery path) — consume results promptly."""
+        return self._results.pop(rid, None)
+
+    # -- engine loop ------------------------------------------------------
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """One engine iteration: admit+prefill into free slots, advance
+        every decoding slot one token, evict finished sequences. Returns
+        ``{rid: generated tokens}`` for requests that finished now."""
+        finished: Dict[int, np.ndarray] = {}
+        while True:  # admissions can cascade as early-EOS slots free up
+            # pages are reserved inside the admit callback, so each
+            # can_admit check sees the pool net of earlier admissions
+            # in the same call (no over-commit on a down-sized pool)
+            admitted = self.scheduler.admit(
+                on_admit=lambda slot, req: self.cache.reserve(
+                    slot, req.total_tokens))
+            if not admitted:
+                break
+            for slot in admitted:
+                self._prefill_slot(slot)
+            finished.update(self._evict())
+
+        dslots = self.scheduler.decode_slots()
+        if dslots:
+            # occupancy/utilization of the batch the decode step
+            # actually runs with (recorded before eviction, which
+            # empties finished slots' lengths)
+            self._reg.gauge("serving_slot_occupancy",
+                            "fraction of decode slots live").set(
+                                len(dslots) / self.scheduler.num_slots)
+            self._reg.gauge("serving_page_utilization",
+                            "live tokens / page-pool capacity").set(
+                                self.cache.utilization())
+            n = self.decode_block
+            s_tot = self.scheduler.num_slots
+            tokens = np.zeros((s_tot,), np.int32)
+            for i in dslots:
+                tokens[i] = self.scheduler.slots[i].generated[-1]
+            w = self._gather_width(dslots)
+            t0 = time.monotonic()
+            out, self.cache.pages = self.decode_step(
+                self.params, self.cache.pages,
+                jnp.asarray(self.cache.block_tables[:, :w]),
+                jnp.asarray(self.cache.lengths), jnp.asarray(tokens))
+            out = np.asarray(out)                    # (S, decode_block)
+            self._reg.histogram(
+                "serving_decode_step_seconds",
+                "wall time per decode block (sync included)").observe(
+                    time.monotonic() - t0)
+            kept = 0
+            for i in dslots:
+                st = self.scheduler.slots[i]
+                req = st.request
+                budget = req.max_new_tokens - len(st.generated)
+                for j in range(min(n, budget)):
+                    tok = int(out[i, j])
+                    st.generated.append(tok)
+                    kept += 1
+                    if req.eos_id is not None and tok == req.eos_id:
+                        break
+                if not st.finished():
+                    # device advanced this slot the full block
+                    self.cache.lengths[i] += n
+            self._reg.counter("serving_tokens_total",
+                              "decode tokens produced").inc(kept)
+            self._reg.counter("serving_steps_total").inc()
+            self.recompile_detector.check()
+            finished.update(self._evict())
+
+        return finished
+
+    def generate_many(self, prompts: Sequence, max_new_tokens: int = 32,
+                      eos_id: Optional[int] = None,
+                      max_steps: Optional[int] = None) -> List[np.ndarray]:
+        """Submit ``prompts`` and run the loop until all finish; returns
+        each request's generated tokens in submission order."""
+        rids = [self.submit(p, max_new_tokens, eos_id) for p in prompts]
+        collected: Dict[int, np.ndarray] = {}
+        steps = 0
+        while not self.scheduler.idle():
+            collected.update(self.step())
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(f"no convergence in {max_steps} steps")
+        for r in rids:          # consumed here; drop from the store
+            self._results.pop(r, None)
+        return [collected[r] for r in rids]
+
+    def _evict(self) -> Dict[int, np.ndarray]:
+        out = {}
+        for slot, st in self.scheduler.evict_finished().items():
+            self.cache.free_slot(slot)
+            toks = np.asarray(st.generated, np.int32)
+            self._results[st.request.rid] = toks
+            out[st.request.rid] = toks
+        while len(self._results) > self._results_cap:
+            self._results.popitem(last=False)   # oldest unconsumed
+        return out
+
+    # -- prefill ----------------------------------------------------------
+
+    def _prefill_slot(self, slot: int):
+        """Feed an admitted slot's prompt through the chunked prefill
+        step (its pages were already reserved at admission)."""
+        st = self.scheduler.slots[slot]
+        req = st.request
+        self._reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit -> slot admission wait").observe(
+                max(st.admitted_at - req.submitted_at, 0.0))
+        prompt = req.prompt
+        c = self.prefill_chunk
+        bt_row = jnp.asarray(self.cache.block_tables[slot])
+        nxt = None
+        t0 = time.monotonic()
+        for lo in range(0, prompt.shape[0], c):
+            chunk = prompt[lo:lo + c]
+            n_valid = chunk.shape[0]
+            if n_valid < c:
+                chunk = np.pad(chunk, (0, c - n_valid))
+            nxt, self.cache.pages = self.prefill_step(
+                self.params, self.cache.pages, bt_row,
+                jnp.asarray(lo, jnp.int32), jnp.asarray(chunk),
+                jnp.asarray(n_valid, jnp.int32))
+            self.cache.lengths[slot] += n_valid
+            st.prefilled += n_valid
+        st.generated.append(int(nxt))
+        st.first_token_at = time.monotonic()
+        self._reg.histogram(
+            "serving_prefill_seconds",
+            "wall time prefilling one request (all chunks)").observe(
+                st.first_token_at - t0)
+        self._reg.histogram("serving_ttft_seconds",
+                            "submit -> first token latency").observe(
+                                st.first_token_at - req.submitted_at)
+        self._reg.counter("serving_prefill_tokens_total").inc(
+            int(prompt.shape[0]))
+        self._reg.counter("serving_tokens_total").inc()
+
+    def _gather_width(self, dslots) -> int:
+        """Pow2 page count covering every active slot through one decode
+        block — the lax gather (and the Pallas grid) then scale with the
+        LIVE high-water mark, not full slot capacity. Pow2 bucketing
+        keeps the set of compiled shapes log-sized; :meth:`warmup`
+        precompiles them all."""
+        c = self.cache.config
+        max_len = max(int(self.cache.lengths[i]) for i in dslots)
+        need = c.pages_for(max_len + self.decode_block)
+        w = 1
+        while w < need:
+            w *= 2
+        return min(w, c.max_pages_per_slot)
+
+    def warmup(self):
+        """Compile every decode gather-width bucket and the prefill
+        chunk up front (all against the null page — no live state is
+        touched), so a serving process takes its compiles at startup and
+        the steady-state loop stays at ZERO recompiles."""
+        c = self.cache.config
+        s_tot = self.scheduler.num_slots
+        widths, w = [], 1
+        while w < c.max_pages_per_slot:
+            widths.append(w)
+            w *= 2
+        widths.append(c.max_pages_per_slot)
+        zeros = jnp.zeros((s_tot,), jnp.int32)
+        for w in sorted(set(widths)):
+            _, self.cache.pages = self.decode_step(
+                self.params, self.cache.pages,
+                jnp.zeros((s_tot, w), jnp.int32), zeros, zeros)
+        _, self.cache.pages = self.prefill_step(
+            self.params, self.cache.pages,
+            jnp.zeros((c.max_pages_per_slot,), jnp.int32),
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros((self.prefill_chunk,), jnp.int32),
+            jnp.asarray(1, jnp.int32))
+
+    # -- jitted step bodies ----------------------------------------------
+
+    def _decode_step_impl(self, params, pages, block_tables, lengths,
+                          tokens):
+        """Fixed-shape batched decode of ONE BLOCK of ``decode_block``
+        tokens per slot: each inner iteration enters every slot's
+        current token at position ``lengths[s]``, lands its K/V in the
+        slot's current page, and attends ragged-paged over live pages
+        only — one host round-trip per block instead of per token.
+        Inactive slots (length 0) and post-EOS/post-cap lanes write to
+        the null page / past their reservation and produce discarded
+        garbage (the host keeps only in-budget, pre-EOS tokens).
+        Returns (tokens (S, decode_block), pages)."""
+        model, cfg = self.model, self.model.cfg
+        ps = self.cache.config.page_size
+        s_tot = tokens.shape[0]
+        w = block_tables.shape[1]
+        slot_ids = jnp.arange(s_tot)
+
+        def one_token(pages, lengths, tokens):
+            pos = jnp.minimum(lengths, cfg.max_position - 1)
+            x = (model.wte(params["wte"], tokens[:, None])
+                 + model.wpe(params["wpe"], pos[:, None]))      # (S,1,D)
+            page_idx = block_tables[slot_ids,
+                                    jnp.minimum(lengths // ps, w - 1)]
+            off = lengths % ps
+            new_pages = []
+            for i, block in enumerate(model.blocks):
+                bp = params["blocks"][str(i)]
+                h = block.ln1(bp["ln1"], x)
+                q, k, v = block.attn.qkv_heads(bp["attn"], h)   # (S,H,1,Dh)
+                kp, vp = pages[i]
+                kp = kp.at[page_idx, off].set(
+                    k[:, :, 0, :].astype(kp.dtype))
+                vp = vp.at[page_idx, off].set(
+                    v[:, :, 0, :].astype(vp.dtype))
+                att = DA.ragged_paged_decode_attention(
+                    q[:, :, 0, :], kp, vp, block_tables, lengths + 1,
+                    impl=self.attn_impl)                        # (S,H,Dh)
+                x = x + block.attn.proj_out(bp["attn"],
+                                            att[:, :, None, :])
+                x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
+                new_pages.append((kp, vp))
+            x = model.ln_f(params["ln_f"], x)
+            logits = jnp.einsum("bd,vd->bv", x[:, 0],
+                                params["wte"]["weight"])
+            return new_pages, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        out = jnp.zeros((s_tot, self.decode_block), jnp.int32)
+
+        def body(j, carry):
+            pages, lengths, tokens, out = carry
+            pages, nxt = one_token(pages, lengths, tokens)
+            return pages, lengths + 1, nxt, out.at[:, j].set(nxt)
+
+        pages, _, _, out = jax.lax.fori_loop(
+            0, self.decode_block, body, (pages, lengths, tokens, out))
+        return out, pages
+
+    def _prefill_chunk_impl(self, params, pages, bt_row, start, tokens,
+                            n_valid):
+        """Fixed-shape chunked prefill for ONE slot: ``tokens`` (C,) at
+        positions ``start..start+C-1`` (first ``n_valid`` real, rest
+        pad). Writes the chunk's K/V into the slot's pages and attends
+        causally over everything cached so far. Returns (greedy next
+        token after the chunk's last valid position, pages)."""
+        model, cfg = self.model, self.model.cfg
+        ps = self.cache.config.page_size
+        mp = self.cache.config.max_pages_per_slot
+        c = tokens.shape[0]
+        positions = start + jnp.arange(c, dtype=jnp.int32)
+        pos_e = jnp.minimum(positions, cfg.max_position - 1)
+        x = (model.wte(params["wte"], tokens[None, :])
+             + model.wpe(params["wpe"], pos_e[None, :]))        # (1,C,D)
+        valid = jnp.arange(c) < n_valid
+        page_idx = jnp.where(
+            valid, bt_row[jnp.minimum(positions // ps, mp - 1)], 0)
+        off = positions % ps
+        new_pages = []
+        for i, block in enumerate(model.blocks):
+            bp = params["blocks"][str(i)]
+            h = block.ln1(bp["ln1"], x)
+            q, k, v = block.attn.qkv_heads(bp["attn"], h)       # (1,H,C,Dh)
+            kp, vp = pages[i]
+            k_tok = k[0].transpose(1, 0, 2)                     # (C,H,Dh)
+            v_tok = v[0].transpose(1, 0, 2)
+            kp = kp.at[page_idx, off].set(k_tok.astype(kp.dtype))
+            vp = vp.at[page_idx, off].set(v_tok.astype(vp.dtype))
+            att = DA.paged_prefill_attention(
+                q[0].transpose(1, 0, 2), kp, vp, bt_row, positions)
+            x = x + block.attn.proj_out(bp["attn"],
+                                        att.transpose(1, 0, 2)[None])
+            x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
+            new_pages.append((kp, vp))
+        x = model.ln_f(params["ln_f"], x)
+        last = jax.lax.dynamic_index_in_dim(
+            x[0], jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False)
+        logits = last @ params["wte"]["weight"].T
+        return jnp.argmax(logits).astype(jnp.int32), new_pages

@@ -1,0 +1,144 @@
+"""Paged KV cache: fixed-size pages + per-slot block tables.
+
+The dense serving cache (``GPT.init_cache``) allocates
+``B × H × max_len × Dh`` per layer — every request pays for the longest
+request's horizon. Here K/V live in fixed-size *pages* shared by all
+slots; a host-side allocator hands pages to slots as their sequences
+grow and reclaims them the step a sequence finishes, so HBM scales with
+**live tokens** (plus one page of rounding per slot).
+
+Device state (threaded through the jitted step, donated):
+  pages[layer] = (k_pages, v_pages), each (num_pages, page_size, H, Dh)
+
+Host state (plain numpy, mutated by the allocator):
+  block_tables (num_slots, max_pages_per_slot) int32 — page ids, row-
+    filled in sequence order; unused entries hold 0 (the null page)
+  lengths      (num_slots,) int32 — live tokens per slot
+
+Page 0 is reserved as the **null page**: never allocated, the write
+target for masked/inactive lanes inside the fixed-shape step, and the
+harmless gather target for unused block-table entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class PagedCacheConfig:
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_slots: int
+    page_size: int = 16
+    num_pages: int = 256
+    max_pages_per_slot: int = 16
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.page_size < 1 or self.num_pages < 2:
+            raise ValueError("need page_size >= 1 and num_pages >= 2 "
+                             "(page 0 is the reserved null page)")
+        if self.max_pages_per_slot < 1:
+            raise ValueError("max_pages_per_slot must be >= 1")
+
+    @property
+    def max_tokens_per_slot(self) -> int:
+        return self.max_pages_per_slot * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+
+class PageOverflowError(RuntimeError):
+    """No free pages (or slot capacity exceeded) for a reservation."""
+
+
+class PagedKVCache:
+    """Device pages + host-side page allocator and block tables."""
+
+    def __init__(self, config: PagedCacheConfig):
+        self.config = config
+        c = config
+        shape = (c.num_pages, c.page_size, c.num_heads, c.head_dim)
+        self.pages: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+            (jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
+            for _ in range(c.num_layers)]
+        self.block_tables = np.zeros((c.num_slots, c.max_pages_per_slot),
+                                     np.int32)
+        self.lengths = np.zeros((c.num_slots,), np.int32)
+        # page 0 reserved: null page
+        self._free = list(range(c.num_pages - 1, 0, -1))
+        self._slot_pages: List[List[int]] = [[] for _ in range(c.num_slots)]
+
+    # -- allocator --------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.config.num_pages - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        """Live-token fraction of the allocatable page pool."""
+        cap = (self.config.num_pages - 1) * self.config.page_size
+        return float(self.lengths.sum()) / cap if cap else 0.0
+
+    def can_reserve(self, n_tokens: int) -> bool:
+        need = self.config.pages_for(n_tokens)
+        return (need <= len(self._free)
+                and need <= self.config.max_pages_per_slot)
+
+    def reserve(self, slot: int, n_tokens: int):
+        """Pre-allocate every page ``slot`` will need for ``n_tokens``
+        total tokens (prompt + generation horizon). All-or-nothing, so
+        an admitted request can never OOM mid-decode."""
+        if self._slot_pages[slot]:
+            raise PageOverflowError(f"slot {slot} already holds pages")
+        need = self.config.pages_for(n_tokens)
+        if need > self.config.max_pages_per_slot:
+            raise PageOverflowError(
+                f"{n_tokens} tokens needs {need} pages > max_pages_per_slot"
+                f"={self.config.max_pages_per_slot}")
+        if need > len(self._free):
+            raise PageOverflowError(
+                f"{need} pages needed, {len(self._free)} free")
+        got = [self._free.pop() for _ in range(need)]
+        self._slot_pages[slot] = got
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, :need] = got
+        self.lengths[slot] = 0
+
+    def free_slot(self, slot: int):
+        """Return the slot's pages to the pool (the step a request
+        finishes — continuous batching's whole point)."""
+        self._free.extend(reversed(self._slot_pages[slot]))
+        self._slot_pages[slot] = []
+        self.block_tables[slot, :] = 0
+        self.lengths[slot] = 0
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages[slot])
+
+    # -- device views -----------------------------------------------------
+
+    def device_tables(self):
+        """(block_tables, lengths) as device arrays for the jitted step."""
+        return jnp.asarray(self.block_tables), jnp.asarray(self.lengths)
+
+    def check_invariants(self):
+        """Allocator self-check (tests): no page is double-owned, free +
+        owned + null == num_pages."""
+        owned = [p for sp in self._slot_pages for p in sp]
+        assert 0 not in owned, "null page allocated"
+        assert 0 not in self._free, "null page in free list"
+        all_pages = owned + self._free
+        assert len(set(all_pages)) == len(all_pages), "page double-owned"
+        assert len(all_pages) == self.config.num_pages - 1

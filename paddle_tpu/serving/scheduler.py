@@ -1,0 +1,142 @@
+"""Continuous-batching scheduler: keep every decode slot full.
+
+Lock-step batch decoding finishes when the *longest* request finishes;
+every early-EOS sequence wastes its slot as padding until then. Here a
+fixed number of decode slots run one fixed-shape step together, and the
+scheduler (pure host logic — no jax, unit-testable with randomized
+arrivals):
+
+  - admits queued requests into free slots the moment slots + pages are
+    available (admission order is FIFO; a too-big-for-now request blocks
+    the queue rather than starving — no head-of-line reordering, so
+    completion is guaranteed);
+  - evicts a sequence the step it finishes (EOS or its own length cap),
+    releasing its slot and pages for the next admission;
+  - tracks queue-wait / first-token timestamps for the engine's metrics.
+
+The scheduler never touches device state: the engine owns the jitted
+step and the paged cache; this class only decides *which request sits
+in which slot when*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray              # (S0,) int32
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    submitted_at: float = 0.0
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.prompt.shape[0]) + self.max_new_tokens
+
+
+@dataclasses.dataclass
+class SlotState:
+    request: Request
+    generated: List[int] = dataclasses.field(default_factory=list)
+    prefilled: int = 0              # prompt tokens already in the cache
+    admitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.prefilled >= int(self.request.prompt.shape[0])
+
+    def finished(self) -> bool:
+        r = self.request
+        if len(self.generated) >= r.max_new_tokens:
+            return True
+        return (r.eos_id is not None and self.generated
+                and self.generated[-1] == r.eos_id)
+
+
+class ContinuousBatchingScheduler:
+    """FIFO queue + slot table. ``can_admit(request)`` is injected by the
+    engine (page availability lives in the cache, not here)."""
+
+    def __init__(self, num_slots: int,
+                 can_admit: Optional[Callable[[Request], bool]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.num_slots = num_slots
+        self.slots: List[Optional[SlotState]] = [None] * num_slots
+        self.queue: Deque[Request] = deque()
+        self._can_admit = can_admit or (lambda r: True)
+        self._clock = clock
+        self._ids = itertools.count()
+
+    # -- queue ------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(next(self._ids), prompt, max_new_tokens, eos_id,
+                      submitted_at=self._clock())
+        self.queue.append(req)
+        return req.rid
+
+    # -- slot bookkeeping -------------------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def active_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def decode_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and s.prefill_done]
+
+    def occupancy(self) -> float:
+        return len(self.active_slots()) / self.num_slots
+
+    def admit(self, on_admit=None) -> List[int]:
+        """Move queued requests into free slots (FIFO, head-blocking).
+        Returns the slot indices admitted this call; the engine then
+        prefills them. Stops at the first request the cache cannot hold
+        yet — its pages free up as running sequences finish.
+
+        ``on_admit(slot, request)`` fires immediately per admission,
+        BEFORE the next request's ``can_admit`` check — the engine
+        reserves pages there, so one call admitting several requests
+        can never over-commit the pool against a stale free count."""
+        admitted = []
+        for slot in self.free_slots():
+            if not self.queue:
+                break
+            if not self._can_admit(self.queue[0]):
+                break
+            req = self.queue.popleft()
+            self.slots[slot] = SlotState(req, admitted_at=self._clock())
+            if on_admit is not None:
+                on_admit(slot, req)
+            admitted.append(slot)
+        return admitted
+
+    def evict_finished(self) -> Dict[int, SlotState]:
+        """Pop every finished slot; returns {slot: final state}."""
+        done = {}
+        for i, st in enumerate(self.slots):
+            if st is not None and st.finished():
+                done[i] = st
+                self.slots[i] = None
+        return done
+
+    def idle(self) -> bool:
+        return not self.queue and not self.active_slots()

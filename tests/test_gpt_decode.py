@@ -80,6 +80,54 @@ class TestCachedDecode:
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+class TestBucketedGenerate:
+    """Pow2 shape bucketing caps serving recompiles: every prompt length
+    inside a bucket reuses ONE compiled decode graph (ISSUE 4
+    satellite), with tokens identical to generate(use_cache=True)."""
+
+    def test_parity_across_lengths(self):
+        model, params = _model()
+        rng = np.random.default_rng(0)
+        for s0 in (5, 9, 16):
+            p = rng.integers(1, 64, (2, s0)).astype(np.int32)
+            fast = np.asarray(model.generate_bucketed(
+                params, p, max_new_tokens=6))
+            ref = np.asarray(model.generate(
+                params, jnp.asarray(p), max_new_tokens=6, use_cache=True))
+            np.testing.assert_array_equal(fast, ref)
+
+    def test_zero_recompiles_within_bucket(self):
+        """RecompileDetector proof: compile-counter delta == 0 across
+        three different prompt lengths in one pow2 bucket."""
+        from paddle_tpu import observability as obs
+        model, params = _model(seed=3)
+        rng = np.random.default_rng(1)
+
+        def run(s0):
+            model.generate_bucketed(
+                params, rng.integers(1, 64, (2, s0)).astype(np.int32),
+                max_new_tokens=6)
+
+        det = obs.RecompileDetector("bucketed_generate", warmup=1)
+        run(9)          # warmup: compiles the (16, 8) bucket once
+        det.check()
+        for s0 in (10, 12, 14):
+            run(s0)
+            assert det.check() == 0, f"recompiled at prompt length {s0}"
+        assert det.recompiles == 0
+
+    def test_rejects_stacked_layout(self):
+        model, params = _model(stacked_layers=True)
+        with pytest.raises(ValueError):
+            model.generate_bucketed(params, np.zeros((1, 4), np.int32), 4)
+
+    def test_overflow_guard(self):
+        model, params = _model()   # max_position = 32
+        with pytest.raises(ValueError):
+            model.generate_bucketed(params, np.zeros((1, 30), np.int32),
+                                    max_new_tokens=8)
+
+
 class TestTransformerCachedDecode:
     """Cached greedy/beam decoding parity for the seq2seq Transformer."""
 

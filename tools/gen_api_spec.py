@@ -57,6 +57,7 @@ def iter_api():
         "paddle_tpu.fleet": pt.fleet,
         "paddle_tpu.observability": pt.observability,
         "paddle_tpu.resilience": pt.resilience,
+        "paddle_tpu.serving": pt.serving,
         "paddle_tpu.profiler": pt.profiler,
         "paddle_tpu.debug": pt.debug,
         "paddle_tpu.trainer": pt.trainer,

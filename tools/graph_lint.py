@@ -122,9 +122,38 @@ def lint_convgroup(suppressions):
         name="vgg_convgroup", suppressions=suppressions)
 
 
+def lint_serving_decode(suppressions):
+    """The serving engine's continuous-batching decode step — the hot
+    path of ISSUE 4. Unlike the bare ``gpt_decode`` surface above, the
+    engine IS the donating surface: its jitted step donates the KV cache
+    pages (single-use by construction — the engine replaces its page
+    handles every call), so this must lint clean with NO undonated-
+    buffer suppression."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = serving.ServingEngine(model, params, num_slots=4, page_size=8,
+                                max_tokens_per_slot=64, attn_impl="lax")
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.decode_step, analysis.abstractify(params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_decode", ast_fn=eng._decode_step_impl,
+        suppressions=suppressions)
+
+
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
-                  lint_convgroup],
+                  lint_convgroup, lint_serving_decode],
 }
 
 

@@ -33,6 +33,16 @@ $PYTEST tests/ -m "not slow"
 echo "== bench smoke (int8 dryrun) =="
 python tools/int8_bench.py --dryrun > /dev/null
 
+# serving-bench smoke: the continuous-batching engine + paged decode must
+# run end-to-end on CPU and self-validate the BENCH_SERVING schema (incl.
+# the zero-steady-state-recompiles invariant) before any TPU session
+echo "== bench smoke (serving dryrun) =="
+SERVING_OUT="$(python bench.py --model serving --dryrun)"
+if echo "$SERVING_OUT" | grep -q '"error"'; then
+  echo "serving bench dryrun failed: $SERVING_OUT"
+  exit 1
+fi
+
 # static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
 # decode, VGG conv-group dropout) must be free of error-severity graph
 # hazards (host syncs, key reuse, tracer branches); accepted warnings
