@@ -170,6 +170,39 @@ class Histogram(_Metric):
                     "mean": cell.sum / cell.count,
                     "min": cell.min, "max": cell.max}
 
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile estimate (the
+        ``histogram_quantile`` convention): linear interpolation inside
+        the bucket holding the q-th sample, clamped to the observed
+        [min, max] so a wide bucket cannot report a value no sample ever
+        reached. ``q`` in [0, 1]. Returns 0.0 for an empty histogram."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        cell = self._cell(labels)
+        with self._lock:
+            if not cell.count:
+                return 0.0
+            target = q * cell.count
+            cum = 0.0
+            lo = cell.min
+            for i, c in enumerate(cell.counts):
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else cell.max)
+                if c and cum + c >= target:
+                    frac = (target - cum) / c
+                    v = lo + frac * max(hi - lo, 0.0)
+                    return min(max(v, cell.min), cell.max)
+                cum += c
+                # advance past EMPTY buckets too: the target bucket's
+                # lower edge is its true floor, and a stale `lo` from
+                # a distant outlier would interpolate below it
+                lo = hi
+            return cell.max
+
+    def percentiles(self, *qs: float, **labels) -> Dict[str, float]:
+        """{'p50': ..., 'p99': ...} for the given quantiles (0-1)."""
+        return {f"p{q * 100:g}": self.quantile(q, **labels) for q in qs}
+
 
 class MetricsRegistry:
     """Name -> metric table; the process-wide instance is ``default()``."""

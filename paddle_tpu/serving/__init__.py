@@ -1,34 +1,48 @@
-"""Paged KV-cache serving engine: continuous batching + ragged decode.
+"""Paged KV-cache serving engine: continuous batching, batched chunked
+prefill, prefix sharing, and SLO-aware scheduling.
 
-The serving-throughput subsystem (ISSUE 4). Four parts:
+The serving-throughput subsystem (ISSUE 4 + the ISSUE 6 prefill/SLO
+rebuild). Four parts:
 
 1. **Paged KV cache** (`paged_cache.py`): K/V in fixed-size pages with
    per-slot block tables and a host-side allocator — HBM scales with
-   live tokens, not ``batch × max_len``.
-2. **Ragged paged decode attention** (`decode_attention.py`): one
-   fixed-shape kernel call attends every slot's query over only its own
-   live pages (Pallas with block-table scalar prefetch; lax fallback and
-   an ``interpret=True`` path so CPU tier-1 tests run the real kernel).
-3. **Continuous-batching scheduler** (`scheduler.py`): fixed decode
-   slots, FIFO admission into freed slots, immediate eviction on
-   EOS/length cap — pure host logic.
+   live tokens, not ``batch × max_len`` — plus **refcounted prefix
+   sharing**: published prompt-prefix pages are mapped copy-free into
+   new requests' block tables (a shared system prompt is prefilled once
+   for thousands of requests), with copy-on-write for shared tail pages.
+2. **Ragged paged attention kernels** (`decode_attention.py`): one
+   fixed-shape call attends every slot's query token (decode) or query
+   CHUNK (batched prefill) over only its own live pages (Pallas with
+   block-table scalar prefetch; lax fallback and an ``interpret=True``
+   path so CPU tier-1 tests run the real kernels).
+3. **Schedulers** (`scheduler.py`): fixed decode slots with immediate
+   EOS eviction — plain FIFO (`ContinuousBatchingScheduler`) or
+   SLO-aware (`SLOScheduler`: priority lanes, TTFT deadlines, bounded-
+   skip anti-starvation, structured `LoadShedError` load shedding) —
+   pure host logic.
 4. **ServingEngine** (`engine.py`): ``submit``/``step``/
    ``generate_many`` driving one jit-compiled fixed-shape decode step
-   with donated cache pages (zero steady-state recompiles, proven by a
-   ``RecompileDetector``), wired into the observability registry.
+   AND one batched chunked-prefill step with donated cache pages (zero
+   steady-state recompiles, proven by a ``RecompileDetector``), prefill/
+   decode interleaving under a token budget, wired into the
+   observability registry with split TTFT accounting.
 """
 
 from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
                                             PageOverflowError)
-from paddle_tpu.serving.decode_attention import (paged_prefill_attention,
-                                                 ragged_paged_decode_attention)
+from paddle_tpu.serving.decode_attention import (
+    paged_prefill_attention, ragged_paged_decode_attention,
+    ragged_paged_prefill_attention)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                          Request, SlotState)
+                                          LoadShedError, Reject, Request,
+                                          SLOScheduler, SlotState)
 from paddle_tpu.serving.engine import ServingEngine
 
 __all__ = [
     "PagedCacheConfig", "PagedKVCache", "PageOverflowError",
     "paged_prefill_attention", "ragged_paged_decode_attention",
-    "ContinuousBatchingScheduler", "Request", "SlotState",
+    "ragged_paged_prefill_attention",
+    "ContinuousBatchingScheduler", "SLOScheduler", "LoadShedError",
+    "Reject", "Request", "SlotState",
     "ServingEngine",
 ]

@@ -1,28 +1,38 @@
-"""Ragged paged decode attention: the serving engine's hot kernel.
+"""Ragged paged attention kernels: the serving engine's hot path.
 
-One fixed-shape call attends every decode slot's single query token over
-only that slot's *live* KV pages — the "Ragged Paged Attention" TPU
-serving pattern (PAPERS.md): sequences of wildly different lengths batch
-into one step, and work/HBM traffic scale with live tokens, not with
-``batch × max_len`` padding.
+One fixed-shape call attends every slot's query token(s) over only that
+slot's *live* KV pages — the "Ragged Paged Attention" TPU serving
+pattern (PAPERS.md): sequences of wildly different lengths batch into
+one step, and work/HBM traffic scale with live tokens, not with
+``batch × max_len`` padding. Two kernels share the layout and the
+online-softmax structure (the reusable-kernel argument of Tensor
+Processing Primitives — prefill is a chunk-sized variant of decode, not
+a fourth bespoke module):
 
-Layouts
+``ragged_paged_decode_attention`` — one query token per slot:
   q            (S, H, Dh)        one query token per decode slot
   k/v pages    (P, ps, H, Dh)    fixed-size pages, token-major
   block_tables (S, max_pages)    page ids per slot (page 0 = null page)
   lengths      (S,)              live tokens per slot (0 = inactive slot)
 
-Two implementations with identical numerics:
+``ragged_paged_prefill_attention`` — a CHUNK of C query tokens per slot
+(the batched multi-request chunked-prefill step, ISSUE 6): queries sit
+at absolute positions ``chunk_starts[s] + c`` and attend causally over
+everything the slot has cached, including this chunk's own causal
+prefix (whose K/V the caller writes before attending). Lanes past
+``n_valid[s]`` (and whole inactive slots, ``n_valid == 0``) emit exact
+zeros.
+
+Each has two implementations with identical numerics:
 
 - ``impl="lax"``: XLA gather + masked softmax (CPU/debug reference).
 - ``impl="pallas"`` / ``"pallas_interpret"``: a Pallas kernel, grid
   ``(S, H, max_pages)``, that scalar-prefetches the block table so each
   kv block's HBM address is known before the body runs (the
   PrefetchScalarGridSpec pattern), does online-softmax accumulation over
-  pages, and skips pages past the slot's length entirely. The interpret
-  path runs the REAL kernel on CPU, so tier-1 tests exercise it.
-
-Fully-masked slots (length 0) emit exact zeros on both paths.
+  pages, and skips pages past the slot's live extent entirely. The
+  interpret path runs the REAL kernel on CPU, so tier-1 tests exercise
+  it.
 """
 
 from __future__ import annotations
@@ -173,6 +183,129 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
 
 
 # ---------------------------------------------------------------------------
+# batched chunked prefill: lax reference + Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_prefill_lax(q, k_pages, v_pages, block_tables, chunk_starts,
+                       n_valid, scale):
+    s_slots, c, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    kg = k_pages[block_tables]                     # (S, mp, ps, H, Dh)
+    vg = v_pages[block_tables]
+    scores = jnp.einsum("schd,smthd->shcmt", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    scores = scores.reshape(s_slots, h, c, mp * ps)
+    tok = jnp.arange(mp * ps, dtype=jnp.int32)
+    pos = chunk_starts[:, None] + jnp.arange(c, dtype=jnp.int32)  # (S, C)
+    causal = tok[None, None, None, :] <= pos[:, None, :, None]
+    row_ok = (jnp.arange(c) < n_valid[:, None])[:, None, :, None]
+    scores = jnp.where(causal & row_ok, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    # masked rows (padding lanes / inactive slots) emit exact zeros
+    alive = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0).reshape(s_slots, h, c, mp, ps)
+    out = jnp.einsum("shcmt,smthd->schd", p, vg.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, k_ref, v_ref,
+                          o_ref, m_scr, l_scr, acc_scr, *, page_size):
+    sl = pl.program_id(0)
+    pj = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(pj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = start_ref[sl]
+    nv = nv_ref[sl]
+
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)      # (C, Dh)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
+        cc = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (C, ps)
+        tok = pj * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (cc, page_size), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (cc, page_size), 0)
+        ok = (tok <= start + row) & (row < nv)         # causal + live lane
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[...]                            # (C, 128)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)      # (C, 1)
+        m_next = jnp.maximum(m_prev, m_cur)            # lanes broadcast
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])                 # (C, ps)
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (C, Dh)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
+
+    # ragged skip: pages wholly past the chunk's live extent do nothing
+    pl.when((nv > 0) & (pj * page_size < start + nv))(_body)
+
+    @pl.when(pj == npg - 1)
+    def _finish():
+        denom = l_scr[...][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        alive = m_scr[...][:, :1] > NEG_INF / 2
+        o_ref[0, :, 0, :] = jnp.where(
+            alive, acc_scr[...] / denom, 0.0).astype(o_ref.dtype)
+
+
+def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
+                          n_valid, scale, interpret):
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
+    s_slots, c, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    qs = (q * jnp.asarray(scale, q.dtype))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # block_tables, chunk_starts, n_valid
+        grid=(s_slots, h, mp),
+        in_specs=[
+            pl.BlockSpec((1, c, 1, dh),
+                         lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
+            pl.BlockSpec((1, ps, 1, dh),
+                         lambda s, hh, j, bt, st, nv: (bt[s, j], 0, hh, 0)),
+            pl.BlockSpec((1, ps, 1, dh),
+                         lambda s, hh, j, bt, st, nv: (bt[s, j], 0, hh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, c, 1, dh),
+                               lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((c, 128), jnp.float32),
+            pltpu.VMEM((c, 128), jnp.float32),
+            pltpu.VMEM((c, dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_prefill_kernel, page_size=ps)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, c, h, dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), chunk_starts.astype(jnp.int32),
+      n_valid.astype(jnp.int32), qs, k_pages, v_pages)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # public entry points
 # ---------------------------------------------------------------------------
 
@@ -197,6 +330,37 @@ def ragged_paged_decode_attention(q, k_pages, v_pages, block_tables,
         return _paged_decode_pallas(q, k_pages, v_pages, block_tables,
                                     lengths, scale,
                                     interpret=impl == "pallas_interpret")
+    raise ValueError(f"unknown impl {impl!r}")
+
+
+def ragged_paged_prefill_attention(q, k_pages, v_pages, block_tables,
+                                   chunk_starts, n_valid, *,
+                                   scale: Optional[float] = None,
+                                   impl: str = "auto"):
+    """One batched chunked-prefill step of attention for every slot.
+
+    ``q`` (S, C, H, Dh) — a chunk of C query tokens per slot, the first
+    ``n_valid[s]`` real (rest padding), at absolute positions
+    ``chunk_starts[s] + c``; keys/values are read from each slot's pages
+    via ``block_tables`` (S, max_pages). Each live query attends
+    causally to all cache positions ``<= chunk_starts[s] + c`` (earlier
+    chunks, shared prefix pages, and this chunk's causal prefix — whose
+    K/V the caller has already written). Padding lanes and inactive
+    slots (``n_valid == 0``) emit exact zeros. Returns (S, C, H, Dh).
+    ``impl``: "auto" (pallas on TPU, lax elsewhere), "lax", "pallas",
+    "pallas_interpret".
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "auto":
+        impl = "pallas" if (pltpu is not None and _on_tpu()) else "lax"
+    if impl == "lax":
+        return _paged_prefill_lax(q, k_pages, v_pages, block_tables,
+                                  chunk_starts, n_valid, scale)
+    if impl in ("pallas", "pallas_interpret"):
+        return _paged_prefill_pallas(q, k_pages, v_pages, block_tables,
+                                     chunk_starts, n_valid, scale,
+                                     interpret=impl == "pallas_interpret")
     raise ValueError(f"unknown impl {impl!r}")
 
 

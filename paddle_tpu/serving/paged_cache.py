@@ -1,4 +1,4 @@
-"""Paged KV cache: fixed-size pages + per-slot block tables.
+"""Paged KV cache: fixed-size pages, block tables, and prefix sharing.
 
 The dense serving cache (``GPT.init_cache``) allocates
 ``B × H × max_len × Dh`` per layer — every request pays for the longest
@@ -18,12 +18,36 @@ Host state (plain numpy, mutated by the allocator):
 Page 0 is reserved as the **null page**: never allocated, the write
 target for masked/inactive lanes inside the fixed-shape step, and the
 harmless gather target for unused block-table entries.
+
+Prefix sharing (ISSUE 6): pages are **refcounted**, and prompt prefixes
+are published to a hash-chained index at *page* granularity once their
+content has actually been prefilled. A new request whose prompt matches
+a published chain maps those pages straight into its block table
+(refcount bump — the shared system-prompt case: prefilled once, mapped
+by every follower) and skips prefilling them. Rules that keep it exact:
+
+- Only the *owner* (the slot that allocated a page) ever writes it; a
+  borrowed page is read-only for the borrower.
+- Matching is verified against the **stored tokens**, never the hash
+  alone — a hash collision can cost a copy, never correctness.
+- A *tail* page (partially filled) can be borrowed too, but the
+  borrower will append into it, so ``reserve`` maps a fresh
+  **copy-on-write** page in its place and records a pending device copy
+  (src → dst) the engine performs before the slot's first prefill.
+  Allocating the CoW page at reservation time preserves the
+  all-or-nothing guarantee: an admitted request can never OOM later.
+- At most ``len(prompt) - 1`` tokens are ever shared, so every request
+  prefills at least one token — the one that produces its first output.
+- A page whose refcount drops to zero while still published parks in an
+  LRU **cached** pool: reusable by future matches, evicted (and
+  unpublished) only when the allocator runs dry.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -39,6 +63,7 @@ class PagedCacheConfig:
     num_pages: int = 256
     max_pages_per_slot: int = 16
     dtype: object = jnp.float32
+    share_prefix: bool = True
 
     def __post_init__(self):
         if self.page_size < 1 or self.num_pages < 2:
@@ -59,8 +84,16 @@ class PageOverflowError(RuntimeError):
     """No free pages (or slot capacity exceeded) for a reservation."""
 
 
+_ROOT_KEY = hash("paddle_tpu.serving.prefix_root")
+
+
+def _chain(parent_key: int, chunk: np.ndarray) -> int:
+    return hash((parent_key, chunk.tobytes()))
+
+
 class PagedKVCache:
-    """Device pages + host-side page allocator and block tables."""
+    """Device pages + host-side page allocator, block tables, and the
+    refcounted prefix-sharing index."""
 
     def __init__(self, config: PagedCacheConfig):
         self.config = config
@@ -75,31 +108,157 @@ class PagedKVCache:
         # page 0 reserved: null page
         self._free = list(range(c.num_pages - 1, 0, -1))
         self._slot_pages: List[List[int]] = [[] for _ in range(c.num_slots)]
+        # -- sharing state --
+        self._ref = np.zeros((c.num_pages,), np.int32)   # mappers per page
+        self._owned: List[set] = [set() for _ in range(c.num_slots)]
+        self._cached: "OrderedDict[int, bool]" = OrderedDict()  # LRU, ref 0
+        self._full_index: Dict[int, int] = {}    # chain key -> page id
+        self._tail_index: Dict[int, int] = {}    # chain key -> tail page id
+        self._page_pub: Dict[int, Tuple[str, int]] = {}  # pid -> (kind, key)
+        self._page_tokens: Dict[int, np.ndarray] = {}    # published content
+        self._published_upto: List[int] = [0] * c.num_slots
+        # per-slot publish cursor: hash-chain key covering the first
+        # _published_upto // page_size pages, so each publish_prefix
+        # call hashes only NEW pages (not the whole prompt again)
+        self._pub_chain: List[int] = [_ROOT_KEY] * c.num_slots
+        # slot -> (src, dst): device copy the engine owes before writing
+        self._pending_copy: Dict[int, Tuple[int, int]] = {}
+        # admission calls can_reserve once per queued candidate per wave
+        # and reserve() repeats the match — memoize on (prompt identity,
+        # index generation) so each prompt is matched once per index
+        # change, not once per scheduler pass; entries pin the array
+        self._index_gen = 0
+        self._match_cache: "OrderedDict[Tuple[int, int], tuple]" = \
+            OrderedDict()
+        self.shared_tokens_total = 0     # prefill tokens skipped via sharing
+        self.cow_copies_total = 0
 
     # -- allocator --------------------------------------------------------
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages immediately allocatable (free + evictable cached)."""
+        return len(self._free) + len(self._cached)
 
     @property
     def pages_in_use(self) -> int:
-        return (self.config.num_pages - 1) - len(self._free)
+        return int((self._ref[1:] > 0).sum())
 
     def utilization(self) -> float:
         """Live-token fraction of the allocatable page pool."""
         cap = (self.config.num_pages - 1) * self.config.page_size
         return float(self.lengths.sum()) / cap if cap else 0.0
 
-    def can_reserve(self, n_tokens: int) -> bool:
-        need = self.config.pages_for(n_tokens)
-        return (need <= len(self._free)
-                and need <= self.config.max_pages_per_slot)
+    def _alloc_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._cached:     # evict the LRU published-but-idle page
+            pid, _ = self._cached.popitem(last=False)
+            self._unpublish(pid)
+            return pid
+        raise PageOverflowError("page pool exhausted")
 
-    def reserve(self, slot: int, n_tokens: int):
+    def _acquire(self, pid: int):
+        """Take a reference on a published page (reviving it from the
+        cached pool if idle)."""
+        if pid in self._cached:
+            del self._cached[pid]
+        self._ref[pid] += 1
+
+    def _release(self, pid: int):
+        self._ref[pid] -= 1
+        assert self._ref[pid] >= 0, f"page {pid} over-released"
+        if self._ref[pid] == 0:
+            if pid in self._page_pub:
+                self._cached[pid] = True     # reusable via the index
+            else:
+                self._free.append(pid)
+
+    def _unpublish(self, pid: int):
+        kind, key = self._page_pub.pop(pid)
+        index = self._full_index if kind == "full" else self._tail_index
+        if index.get(key) == pid:
+            del index[key]
+        self._page_tokens.pop(pid, None)
+        self._index_gen += 1
+
+    # -- prefix matching --------------------------------------------------
+
+    def _match_prefix(self, prompt: Optional[np.ndarray]):
+        """Longest published, content-verified prefix of ``prompt``.
+        Returns (full_page_ids, tail_src_page_or_None, shared_tokens);
+        caps sharing at ``len(prompt) - 1`` so at least one token always
+        prefills (producing the request's first output token). Also
+        returns ``key_after_full``, the hash-chain key covering the
+        matched full pages — ``reserve`` seeds the slot's publish cursor
+        with it so ``publish_prefix`` never rehashes them. Memoized
+        per (prompt identity, index generation): the result only depends
+        on the publication indices, which bump ``_index_gen`` on every
+        change, never on page refcount/cached state. Keying on
+        ``id(prompt)`` keeps the hot path free of whole-prompt copies or
+        hashing — admission probes the same queued Request's array every
+        wave — and the entry pins the array, so its id cannot be reused
+        while the entry lives (prompts are never mutated after submit)."""
+        if prompt is None or not self.config.share_prefix:
+            return [], None, 0, _ROOT_KEY
+        mkey = (id(prompt), self._index_gen)
+        hit = self._match_cache.get(mkey)
+        if hit is not None and hit[0] is prompt:
+            return hit[1]
+        res = self._match_prefix_uncached(prompt)
+        self._match_cache[mkey] = (prompt, res)
+        while len(self._match_cache) > 512:
+            self._match_cache.popitem(last=False)
+        return res
+
+    def _match_prefix_uncached(self, prompt: np.ndarray):
+        ps = self.config.page_size
+        limit = int(prompt.shape[0]) - 1
+        key, k, full = _ROOT_KEY, 0, []
+        while (k + 1) * ps <= limit:
+            chunk = np.asarray(prompt[k * ps:(k + 1) * ps], np.int32)
+            key2 = _chain(key, chunk)
+            pid = self._full_index.get(key2)
+            if pid is None or not np.array_equal(
+                    self._page_tokens[pid], chunk):
+                break
+            full.append(pid)
+            key, k = key2, k + 1
+        shared = k * ps
+        tail_pid = self._tail_index.get(key)
+        if tail_pid is not None:
+            stored = self._page_tokens[tail_pid]
+            rem = np.asarray(prompt[shared:limit], np.int32)
+            n = 0
+            m = min(len(stored), len(rem))
+            while n < m and stored[n] == rem[n]:
+                n += 1
+            if n > 0:
+                return full, (tail_pid, n), shared + n, key
+            return full, None, shared, key
+        return full, None, shared, key
+
+    def can_reserve(self, n_tokens: int,
+                    prompt: Optional[np.ndarray] = None) -> bool:
+        need = self.config.pages_for(n_tokens)
+        if need > self.config.max_pages_per_slot:
+            return False
+        full, _tail, _shared, _key = self._match_prefix(prompt)
+        borrowed_cached = sum(1 for p in full if p in self._cached)
+        fresh = need - len(full)
+        # tail sharing is dropped by reserve() when pinning the CoW src
+        # would not fit, so feasibility only needs the full-page math
+        return fresh <= len(self._free) + len(self._cached) - borrowed_cached
+
+    def reserve(self, slot: int, n_tokens: int,
+                prompt: Optional[np.ndarray] = None) -> int:
         """Pre-allocate every page ``slot`` will need for ``n_tokens``
         total tokens (prompt + generation horizon). All-or-nothing, so
-        an admitted request can never OOM mid-decode."""
+        an admitted request can never OOM mid-decode. With ``prompt``
+        given and sharing enabled, published prefix pages are mapped
+        instead of allocated; returns the number of prompt tokens
+        already covered by shared pages (the engine starts prefill after
+        them and sets ``lengths[slot]`` accordingly — done here)."""
         if self._slot_pages[slot]:
             raise PageOverflowError(f"slot {slot} already holds pages")
         need = self.config.pages_for(n_tokens)
@@ -107,20 +266,119 @@ class PagedKVCache:
             raise PageOverflowError(
                 f"{n_tokens} tokens needs {need} pages > max_pages_per_slot"
                 f"={self.config.max_pages_per_slot}")
-        if need > len(self._free):
+        full, tail, shared, chain_key = self._match_prefix(prompt)
+        borrowed_cached = sum(1 for p in full if p in self._cached)
+        fresh = need - len(full)
+        if (tail is not None
+                and fresh > len(self._free) + len(self._cached)
+                - borrowed_cached
+                - (1 if tail[0] in self._cached else 0)):
+            # pinning the CoW src would leave too few evictable pages:
+            # degrade to sharing the full pages only (the tail tokens
+            # just get recomputed) rather than refusing the request
+            tail, shared = None, len(full) * self.config.page_size
+        if fresh > len(self._free) + len(self._cached) - borrowed_cached:
             raise PageOverflowError(
-                f"{need} pages needed, {len(self._free)} free")
-        got = [self._free.pop() for _ in range(need)]
-        self._slot_pages[slot] = got
+                f"{fresh} pages needed, {len(self._free)} free "
+                f"+ {len(self._cached)} cached")
+        mapped: List[int] = []
+        owned = set()
+        for pid in full:
+            self._acquire(pid)
+            mapped.append(pid)
+        if tail is not None:
+            # pin the CoW src BEFORE allocating fresh pages: _alloc_page
+            # evicts from the cached pool when free runs dry, and the
+            # idle published tail is exactly the kind of page it would
+            # recycle — after which the pending copy would read garbage
+            self._acquire(tail[0])
+        for _ in range(fresh):
+            pid = self._alloc_page()
+            self._ref[pid] = 1
+            owned.add(pid)
+            mapped.append(pid)
+        if tail is not None:
+            src, _n = tail
+            # the borrower appends into this page: map a fresh CoW page
+            # in its place (already counted in ``fresh`` — it replaces
+            # the tail slot position) and owe a device copy
+            self._pending_copy[slot] = (src, mapped[len(full)])
+            self.cow_copies_total += 1
+        self._slot_pages[slot] = mapped
+        self._owned[slot] = owned
+        self._published_upto[slot] = shared
+        self._pub_chain[slot] = chain_key
         self.block_tables[slot, :] = 0
-        self.block_tables[slot, :need] = got
-        self.lengths[slot] = 0
+        self.block_tables[slot, :need] = mapped
+        self.lengths[slot] = shared
+        self.shared_tokens_total += shared
+        return shared
+
+    def pending_copy(self, slot: int) -> Optional[Tuple[int, int]]:
+        """(src, dst) device page copy the engine must perform before
+        the slot's first write (CoW of a borrowed tail page)."""
+        return self._pending_copy.get(slot)
+
+    def copy_done(self, slot: int):
+        src, _dst = self._pending_copy.pop(slot)
+        self._release(src)
+
+    def publish_prefix(self, slot: int, prompt: np.ndarray, upto: int):
+        """Publish the slot's OWN prompt pages whose content has been
+        prefilled through token ``upto``: full pages always; the partial
+        tail page once the whole prompt is in (``upto >= len(prompt)``).
+        Borrowed pages are already published; first publisher wins."""
+        if not self.config.share_prefix:
+            return
+        ps = self.config.page_size
+        upto = min(int(upto), int(prompt.shape[0]))
+        if upto <= self._published_upto[slot]:
+            return
+        # resume from the publish cursor: pages before it are already
+        # published (or borrowed) and their chain key is saved
+        key = self._pub_chain[slot]
+        k = self._published_upto[slot] // ps
+        while (k + 1) * ps <= upto:
+            chunk = np.asarray(prompt[k * ps:(k + 1) * ps], np.int32)
+            key = _chain(key, chunk)
+            pid = self._slot_pages[slot][k]
+            if (key not in self._full_index and pid in self._owned[slot]
+                    and pid not in self._page_pub):
+                self._full_index[key] = pid
+                self._page_pub[pid] = ("full", key)
+                self._page_tokens[pid] = chunk.copy()
+                self._index_gen += 1
+            k += 1
+        self._pub_chain[slot] = key
+        if upto >= int(prompt.shape[0]) and upto % ps:
+            tail = np.asarray(prompt[k * ps:upto], np.int32)
+            pid = self._slot_pages[slot][k]
+            if (key not in self._tail_index and pid in self._owned[slot]
+                    and pid not in self._page_pub):
+                self._tail_index[key] = pid
+                self._page_pub[pid] = ("tail", key)
+                self._page_tokens[pid] = tail.copy()
+                self._index_gen += 1
+        self._published_upto[slot] = upto
+
+    def writable(self, slot: int, page_index: int) -> bool:
+        """True when the slot may write the page at this block-table
+        position (it allocated it — borrowed pages are read-only)."""
+        return self._slot_pages[slot][page_index] in self._owned[slot]
 
     def free_slot(self, slot: int):
-        """Return the slot's pages to the pool (the step a request
-        finishes — continuous batching's whole point)."""
-        self._free.extend(reversed(self._slot_pages[slot]))
+        """Drop the slot's references; pages hit the free pool (or the
+        cached pool, when published) only at refcount zero — continuous
+        batching's whole point, minus whatever prefix sharers still
+        hold."""
+        if slot in self._pending_copy:
+            self.copy_done(slot)     # never materialized; release the src
+        for pid in self._slot_pages[slot]:
+            self._release(pid)
         self._slot_pages[slot] = []
+        self._owned[slot] = set()
+        self._published_upto[slot] = 0
+        self._pub_chain[slot] = _ROOT_KEY
         self.block_tables[slot, :] = 0
         self.lengths[slot] = 0
 
@@ -134,11 +392,30 @@ class PagedKVCache:
         return jnp.asarray(self.block_tables), jnp.asarray(self.lengths)
 
     def check_invariants(self):
-        """Allocator self-check (tests): no page is double-owned, free +
-        owned + null == num_pages."""
-        owned = [p for sp in self._slot_pages for p in sp]
-        assert 0 not in owned, "null page allocated"
-        assert 0 not in self._free, "null page in free list"
-        all_pages = owned + self._free
-        assert len(set(all_pages)) == len(all_pages), "page double-owned"
-        assert len(all_pages) == self.config.num_pages - 1
+        """Allocator self-check (tests): per-page refcount equals the
+        number of mappings holding it, free/cached/live partition the
+        pool, the null page is never owned, published entries resolve."""
+        c = self.config
+        expect = np.zeros((c.num_pages,), np.int32)
+        for sp in self._slot_pages:
+            for p in sp:
+                expect[p] += 1
+        for (src, _dst) in self._pending_copy.values():
+            expect[src] += 1
+        assert expect[0] == 0, "null page mapped"
+        assert (expect == self._ref).all(), (
+            f"refcount drift: {np.nonzero(expect != self._ref)[0]}")
+        free_s, cached_s = set(self._free), set(self._cached)
+        assert len(free_s) == len(self._free), "page double-freed"
+        assert not (free_s & cached_s), "page both free and cached"
+        assert 0 not in free_s and 0 not in cached_s, "null page pooled"
+        live = {int(p) for p in np.nonzero(self._ref)[0]}
+        assert not (live & (free_s | cached_s)), "live page in a pool"
+        assert free_s | cached_s | live == set(range(1, c.num_pages)), \
+            "page leaked"
+        for pid, (kind, key) in self._page_pub.items():
+            index = self._full_index if kind == "full" else self._tail_index
+            assert index.get(key) == pid, "publication index drift"
+            assert pid in self._page_tokens, "published page lost tokens"
+        for owned, sp in zip(self._owned, self._slot_pages):
+            assert owned <= set(sp), "owned page not mapped"

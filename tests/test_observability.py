@@ -144,6 +144,35 @@ class TestRegistry:
         assert s["max"] == pytest.approx(5.0)
         assert s["mean"] == pytest.approx((0.05 + 0.5 + 5.0) / 3)
 
+    def test_histogram_quantiles(self):
+        """Bucket-interpolated p50/p90/p99 (the SLO surface
+        BENCH_SERVING reports): monotone in q, clamped to observed
+        min/max, 0 when empty."""
+        r = obs.MetricsRegistry()
+        h = r.histogram("lat", buckets=(0.1, 0.5, 1.0, 5.0))
+        assert h.quantile(0.99) == 0.0                 # empty
+        for v in (0.2, 0.3, 0.4, 0.45, 0.6, 0.7, 0.8, 0.9, 0.95, 3.0):
+            h.observe(v)
+        p = h.percentiles(0.5, 0.9, 0.99)
+        assert set(p) == {"p50", "p90", "p99"}
+        assert 0.5 <= p["p50"] <= 1.0   # 5th/6th samples' bucket (0.5,1]
+        assert p["p50"] <= p["p90"] <= p["p99"] <= 3.0  # clamped to max
+        assert p["p99"] > 0.9
+        h2 = r.histogram("one", buckets=(10.0,))
+        h2.observe(2.0)
+        # a single sample in a huge bucket must not report beyond it
+        assert h2.quantile(0.99) == pytest.approx(2.0)
+        # empty INTERIOR buckets must not drag the estimate below the
+        # target bucket's lower edge (one fast outlier + a 4.0s cluster:
+        # the median bucket is (3.0, 5.0], so p50 >= 3.0)
+        h3 = r.histogram("gap", buckets=(0.005, 0.1, 1.0, 3.0, 5.0))
+        h3.observe(0.003)
+        for _ in range(99):
+            h3.observe(4.0)
+        assert 3.0 <= h3.quantile(0.5) <= 4.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
     def test_type_conflict_raises(self):
         r = obs.MetricsRegistry()
         r.counter("x_total")

@@ -1,7 +1,8 @@
 """Paged KV-cache serving engine: paged-vs-dense equivalence,
-allocator invariants, ragged decode-attention kernel parity, scheduler
-properties under randomized arrivals, and steady-state recompile-freedom
-(ISSUE 4 acceptance surface)."""
+allocator invariants, ragged decode/prefill-attention kernel parity,
+scheduler properties under randomized arrivals, prefix-sharing
+refcount/CoW invariants, SLO scheduling, and steady-state
+recompile-freedom (ISSUE 4 + ISSUE 6 acceptance surface)."""
 
 import numpy as np
 import jax
@@ -155,6 +156,61 @@ class TestRaggedPagedDecodeAttention:
             q, jnp.asarray(poison_k), jnp.asarray(poison_v), bt, lens,
             impl="lax")
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestRaggedPagedPrefillAttention:
+    """The batched chunked-prefill kernel (ISSUE 6): one call, every
+    slot's next chunk, causal over pages."""
+
+    def _setup(self, seed=0, s=3, c=4, h=2, dh=8, ps=4, mp=4, p=12):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((s, c, h, dh)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((p, ps, h, dh)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((p, ps, h, dh)), jnp.float32)
+        bt = jnp.asarray(rng.integers(1, p, (s, mp)), jnp.int32)
+        return q, kp, vp, bt
+
+    def test_lax_matches_per_row_dense(self):
+        q, kp, vp, bt = self._setup()
+        starts = jnp.asarray([0, 5, 2], jnp.int32)
+        nv = jnp.asarray([4, 3, 4], jnp.int32)
+        out = serving.ragged_paged_prefill_attention(
+            q, kp, vp, bt, starts, nv, impl="lax")
+        dh = q.shape[-1]
+        for s in range(q.shape[0]):
+            k = kp[bt[s]].reshape(-1, *kp.shape[2:])
+            v = vp[bt[s]].reshape(-1, *vp.shape[2:])
+            for c in range(int(nv[s])):
+                n = int(starts[s]) + c + 1        # causal horizon
+                sc = jnp.einsum("hd,thd->ht", q[s, c], k[:n]) / np.sqrt(dh)
+                ref = jnp.einsum("ht,thd->hd",
+                                 jax.nn.softmax(sc, -1), v[:n])
+                np.testing.assert_allclose(
+                    np.asarray(out[s, c]), np.asarray(ref),
+                    atol=1e-5, rtol=1e-5)
+
+    def test_pad_lanes_and_inactive_slots_emit_zeros(self):
+        q, kp, vp, bt = self._setup(seed=1)
+        starts = jnp.asarray([0, 3, 0], jnp.int32)
+        nv = jnp.asarray([2, 4, 0], jnp.int32)    # slot 2 inactive
+        for impl in ("lax", "pallas_interpret"):
+            out = serving.ragged_paged_prefill_attention(
+                q, kp, vp, bt, starts, nv, impl=impl)
+            np.testing.assert_array_equal(np.asarray(out[0, 2:]), 0.0)
+            np.testing.assert_array_equal(np.asarray(out[2]), 0.0)
+
+    def test_pallas_interpret_matches_lax(self):
+        """The REAL kernel (interpret mode) against the lax fallback —
+        mixed starts/valid counts including an idle lane."""
+        q, kp, vp, bt = self._setup(seed=2)
+        starts = jnp.asarray([7, 0, 2], jnp.int32)
+        nv = jnp.asarray([4, 1, 0], jnp.int32)
+        out_l = serving.ragged_paged_prefill_attention(
+            q, kp, vp, bt, starts, nv, impl="lax")
+        out_p = serving.ragged_paged_prefill_attention(
+            q, kp, vp, bt, starts, nv, impl="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_l),
                                    atol=1e-5, rtol=1e-5)
 
 
@@ -340,3 +396,370 @@ class TestServingObservability:
         kp, _ = eng.cache.pages[0]
         dense = 8 * cfg.num_heads * 32 * (cfg.hidden_size // cfg.num_heads)
         assert kp.size < dense / 4
+
+    def test_ttft_split_accounting(self):
+        """ISSUE 6 satellite: submit->admit (queue wait) and
+        admit->first-token (prefill cost) are separate histograms whose
+        sum is the TTFT — scheduler effects no longer hide inside one
+        conflated number."""
+        model, params = _model()
+        rng = np.random.default_rng(11)
+        reg = obs.MetricsRegistry()
+        eng = serving.ServingEngine(model, params, num_slots=2,
+                                    page_size=4, attn_impl="lax",
+                                    registry=reg)
+        n = 5   # > num_slots so some requests genuinely queue
+        eng.generate_many(_prompts(rng, [6] * n), max_new_tokens=3,
+                          max_steps=200)
+        qw = reg.histogram("serving_queue_wait_seconds").summary()
+        a2f = reg.histogram(
+            "serving_admit_to_first_token_seconds").summary()
+        ttft = reg.histogram("serving_ttft_seconds").summary()
+        assert qw["count"] == a2f["count"] == ttft["count"] == n
+        # identical timestamps on both sides of the split: sums add up
+        assert ttft["sum"] == pytest.approx(qw["sum"] + a2f["sum"],
+                                            abs=5e-3)
+        assert reg.histogram("serving_ttft_seconds").quantile(0.99) >= \
+            reg.histogram("serving_ttft_seconds").quantile(0.5)
+
+    def test_prefill_budget_caps_per_step_tokens(self):
+        """The decode/prefill interleaving contract: one step() computes
+        at most ``prefill_budget`` prompt tokens (a long-prompt burst
+        cannot starve in-flight decodes), while a budget below one chunk
+        still advances one lane per round (liveness)."""
+        model, params = _model()
+        rng = np.random.default_rng(13)
+        reg = obs.MetricsRegistry()
+        eng = serving.ServingEngine(model, params, num_slots=4,
+                                    page_size=4, prefill_chunk=8,
+                                    prefill_budget=8, attn_impl="lax",
+                                    registry=reg)
+        prompts = _prompts(rng, [30, 29, 27, 25])
+        rids = [eng.submit(p, 2) for p in prompts]
+        pf = reg.counter("serving_prefill_tokens_total")
+        steps = 0
+        while not eng.scheduler.idle():
+            before = pf.value()
+            eng.step()
+            assert pf.value() - before <= 8, \
+                "step() overshot the prefill budget"
+            steps += 1
+            assert steps < 500
+        for r, p in zip(rids, prompts):
+            assert np.array_equal(eng.result(r),
+                                  _dense_reference(model, params, p, 2))
+
+        reg2 = obs.MetricsRegistry()
+        eng2 = serving.ServingEngine(model, params, num_slots=4,
+                                     page_size=4, prefill_chunk=8,
+                                     prefill_budget=2, attn_impl="lax",
+                                     registry=reg2)
+        pf2 = reg2.counter("serving_prefill_tokens_total")
+        for p in prompts:
+            eng2.submit(p, 2)
+        steps = 0
+        while not eng2.scheduler.idle():
+            before = pf2.value()
+            eng2.step()
+            # sub-chunk budget: exactly one lane runs, so the overshoot
+            # is bounded by a single chunk — never a full batched call
+            assert pf2.value() - before <= 8
+            steps += 1
+            assert steps < 500
+
+
+class TestPrefixSharing:
+    """ISSUE 6: refcounted copy-on-write prefix/page sharing."""
+
+    def test_shared_prefix_parity_and_savings(self):
+        """Greedy tokens identical with sharing on/off; prefill tokens
+        COMPUTED drop when prompts share a system prefix."""
+        model, params = _model(seed=3)
+        rng = np.random.default_rng(20)
+        prefix = rng.integers(1, 64, 10).astype(np.int32)
+        prompts = [np.concatenate([prefix,
+                                   rng.integers(1, 64, t).astype(np.int32)])
+                   for t in (3, 5, 2, 7, 4, 6)]
+
+        def run(share):
+            reg = obs.MetricsRegistry()
+            eng = serving.ServingEngine(model, params, num_slots=2,
+                                        page_size=4, prefill_chunk=8,
+                                        attn_impl="lax",
+                                        prefix_sharing=share, registry=reg)
+            outs = eng.generate_many(prompts, max_new_tokens=5,
+                                     max_steps=300)
+            eng.cache.check_invariants()
+            assert eng.cache.pages_in_use == 0
+            return outs, reg.counter("serving_prefill_tokens_total").value()
+
+        outs_off, computed_off = run(False)
+        outs_on, computed_on = run(True)
+        for a, b in zip(outs_off, outs_on):
+            np.testing.assert_array_equal(a, b)
+        assert computed_on < computed_off, "sharing computed no less"
+        for p, o in zip(prompts, outs_on):
+            np.testing.assert_array_equal(
+                o, _dense_reference(model, params, p, 5))
+
+    def test_identical_prompts_tail_cow_parity(self):
+        """Identical prompts force the shared-TAIL case: followers map
+        the published partial page and must copy-on-write before
+        appending. Tokens stay exactly equal to the dense reference and
+        the published source page is never mutated."""
+        model, params = _model(seed=4)
+        rng = np.random.default_rng(21)
+        prompt = rng.integers(1, 64, 10).astype(np.int32)  # 2 full + tail
+        ref = _dense_reference(model, params, prompt, 6)
+        eng = serving.ServingEngine(model, params, num_slots=1,
+                                    page_size=4, prefill_chunk=8,
+                                    attn_impl="lax")
+        # slot count 1 => strictly sequential: req 0 publishes, later
+        # requests revive the pages from the CACHED pool and CoW the tail
+        out0 = eng.generate_many([prompt.copy()], max_new_tokens=6,
+                                 max_steps=100)[0]
+        np.testing.assert_array_equal(out0, ref)
+        shared_pages = np.asarray(sorted(eng.cache._page_pub))
+        snap = {l: (np.asarray(kp[shared_pages]), np.asarray(vp[shared_pages]))
+                for l, (kp, vp) in enumerate(eng.cache.pages)}
+        tail_pid = next(iter(eng.cache._tail_index.values()))
+        tail_tokens = len(eng.cache._page_tokens[tail_pid])
+        for _ in range(2):
+            out = eng.generate_many([prompt.copy()], max_new_tokens=6,
+                                    max_steps=100)[0]
+            np.testing.assert_array_equal(out, ref)
+        assert eng.cache.cow_copies_total == 2
+        assert eng.cache.shared_tokens_total == 2 * (len(prompt) - 1)
+        for l, (kp, vp) in enumerate(eng.cache.pages):
+            k_now = np.asarray(kp[shared_pages])
+            v_now = np.asarray(vp[shared_pages])
+            for j, pid in enumerate(shared_pages):
+                # published content region must be byte-identical;
+                # (a tail page's offsets >= its published count belong
+                # to the owner and are masked for every sharer)
+                t = tail_tokens if pid == tail_pid else None
+                np.testing.assert_array_equal(k_now[j][:t], snap[l][0][j][:t])
+                np.testing.assert_array_equal(v_now[j][:t], snap[l][1][j][:t])
+        eng.cache.check_invariants()
+
+    def test_randomized_admit_evict_refcount_invariants(self):
+        """Allocator-level property test: randomized reserve / publish /
+        CoW-resolve / free interleavings over a small pool of recurring
+        prompts — pages never leak, never double-free, refcounts always
+        equal the live mapping count."""
+        from paddle_tpu.serving.paged_cache import (PagedCacheConfig,
+                                                    PagedKVCache,
+                                                    PageOverflowError)
+        rng = np.random.default_rng(22)
+        c = PagedKVCache(PagedCacheConfig(
+            num_layers=1, num_heads=2, head_dim=4, num_slots=4,
+            page_size=4, num_pages=14, max_pages_per_slot=4))
+        # small prompt pool => heavy prefix overlap
+        pool = [rng.integers(1, 9, n).astype(np.int32)
+                for n in (6, 9, 10, 13, 10)]
+        pool.append(pool[2].copy())          # exact duplicate
+        live = {}
+        for _step in range(400):
+            op = rng.random()
+            free_slots = [s for s in range(4) if s not in live]
+            if op < 0.5 and free_slots:
+                slot = int(rng.choice(free_slots))
+                prompt = pool[int(rng.integers(len(pool)))]
+                total = len(prompt) + int(rng.integers(1, 4))
+                try:
+                    shared = c.reserve(slot, total, prompt=prompt)
+                except PageOverflowError:
+                    c.check_invariants()
+                    continue
+                assert 0 <= shared < len(prompt)
+                live[slot] = (prompt, shared)
+            elif op < 0.7 and live:
+                slot = int(rng.choice(list(live)))
+                if c.pending_copy(slot) is not None:
+                    c.copy_done(slot)        # engine would device-copy
+                prompt, shared = live[slot]
+                upto = int(rng.integers(shared, len(prompt) + 1))
+                if c.pending_copy(slot) is None:
+                    c.publish_prefix(slot, prompt, upto)
+            elif live:
+                slot = int(rng.choice(list(live)))
+                c.free_slot(slot)
+                del live[slot]
+            c.check_invariants()
+        for slot in list(live):
+            c.free_slot(slot)
+        c.check_invariants()
+        assert c.pages_in_use == 0, "pages leaked"
+
+    def test_cow_src_survives_fresh_allocation_under_pressure(self):
+        """Reserving against a matched tail when fresh allocation must
+        evict from the cached pool: the CoW src page is pinned first —
+        it must never be recycled as the borrower's own fresh page (the
+        pending copy would read garbage). If pinning it leaves too few
+        evictable pages, the tail share degrades to full pages only
+        instead of refusing (or corrupting) the request."""
+        from paddle_tpu.serving.paged_cache import (PagedCacheConfig,
+                                                    PagedKVCache)
+
+        def seeded(num_pages):
+            c = PagedKVCache(PagedCacheConfig(
+                num_layers=1, num_heads=2, head_dim=4, num_slots=2,
+                page_size=4, num_pages=num_pages, max_pages_per_slot=3))
+            p = np.arange(1, 7, dtype=np.int32)   # 1 full page + 2 tail
+            c.reserve(0, 6, prompt=p)
+            c.publish_prefix(0, p, 6)
+            c.free_slot(0)                        # F,T idle in cached pool
+            return c, p
+
+        # roomy pool: tail shared, src pinned BEFORE fresh allocation
+        c, p = seeded(5)
+        assert c.reserve(1, 10, prompt=p.copy()) == 5
+        src, dst = c.pending_copy(1)
+        assert src in c._page_pub, "CoW src evicted by fresh allocation"
+        assert src not in c._owned[1] and src != dst
+        c.copy_done(1)
+        c.check_invariants()
+
+        # tight pool (3 usable pages, request needs 3): pinning the tail
+        # would leave only 1 evictable page for 2 fresh — degrade
+        c, p = seeded(4)
+        assert c.can_reserve(10, prompt=p)
+        assert c.reserve(1, 10, prompt=p.copy()) == 4  # full page only
+        assert c.pending_copy(1) is None
+        c.check_invariants()
+
+    def test_cached_pages_evicted_when_pool_runs_dry(self):
+        """Published-but-idle pages are reusable capacity, not a leak:
+        the allocator evicts them (unpublishing) before refusing."""
+        from paddle_tpu.serving.paged_cache import (PagedCacheConfig,
+                                                    PagedKVCache)
+        c = PagedKVCache(PagedCacheConfig(
+            num_layers=1, num_heads=2, head_dim=4, num_slots=2,
+            page_size=4, num_pages=5, max_pages_per_slot=4))
+        prompt = np.arange(1, 9, dtype=np.int32)       # 2 full pages
+        c.reserve(0, 10, prompt=prompt)                # 3 pages
+        c.publish_prefix(0, prompt, 8)
+        c.free_slot(0)                                 # all 3 idle, 2 cached
+        assert c.pages_in_use == 0 and len(c._cached) == 2
+        c.reserve(1, 16)                               # needs all 4 pages
+        c.check_invariants()
+        assert c.pages_in_use == 4
+        assert not c._full_index, "evicted pages still published"
+
+
+class TestSLOScheduler:
+    """ISSUE 6: priority lanes, deadlines, anti-starvation, shedding."""
+
+    def _sched(self, **kw):
+        from paddle_tpu.serving.scheduler import SLOScheduler
+        t = {"now": 0.0}
+        kw.setdefault("clock", lambda: t["now"])
+        return SLOScheduler(2, **kw), t
+
+    def test_priority_lanes_order(self):
+        s, _ = self._sched()
+        s.submit(np.ones(4, np.int32), 4, lane="batch")
+        s.submit(np.ones(4, np.int32), 4, lane="interactive")
+        s.submit(np.ones(4, np.int32), 4, lane="default")
+        s.admit()
+        lanes = [s.slots[i].request.lane for i in range(2)]
+        assert lanes == ["interactive", "default"]
+        assert s.queue[0].lane == "batch"
+
+    def test_no_head_blocking_but_bounded_skips(self):
+        """A too-big head is skipped (no head-of-line blocking) until
+        its skip budget runs out — then it blocks the line until it
+        fits, so it can never starve."""
+        from paddle_tpu.serving.scheduler import Request
+
+        def can_admit(req: Request):
+            return req.max_new_tokens < 10
+
+        s, _ = self._sched(can_admit=can_admit, starvation_skips=2)
+        big = s.submit(np.ones(4, np.int32), 20)
+        s.submit(np.ones(4, np.int32), 2)
+        assert len(s.admit()) == 1          # small slips past the big head
+        assert s.slots[0].request.max_new_tokens == 2
+        s.submit(np.ones(4, np.int32), 3)
+        assert len(s.admit()) == 1          # skip 2 for big
+        s.evict_finished()
+        s.slots = [None] * 2
+        s.submit(np.ones(4, np.int32), 4)
+        assert s.admit() == []              # big exhausted its skips: blocks
+        assert s.queue[0].rid == big
+
+    def test_deadline_boost_is_edf(self):
+        """At-risk deadlines jump every lane, earliest first."""
+        s, t = self._sched()
+        s.note_ttft(1.0)                    # estimator: ~1s to serve
+        s.submit(np.ones(4, np.int32), 4, lane="interactive")
+        a = s.submit(np.ones(4, np.int32), 4, lane="batch",
+                     ttft_deadline_s=0.5)   # at risk NOW (est 1s > 0.5s)
+        b = s.submit(np.ones(4, np.int32), 4, lane="batch",
+                     ttft_deadline_s=0.3)
+        s.admit()
+        assert {s.slots[0].request.rid, s.slots[1].request.rid} == {a, b}
+        assert s.slots[0].request.rid == b  # earlier deadline first
+
+    def test_load_shed_queue_full_structured(self):
+        from paddle_tpu.serving.scheduler import LoadShedError
+        s, _ = self._sched(max_queue_depth=1)
+        s.submit(np.ones(4, np.int32), 4)
+        with pytest.raises(LoadShedError) as ei:
+            s.submit(np.ones(4, np.int32), 4)
+        r = ei.value.reject
+        assert r.reason == "queue_full" and r.queue_depth == 1
+        assert r.retry_after_s > 0
+        assert s.shed_total == 1
+
+    def test_load_shed_infeasible_deadline(self):
+        from paddle_tpu.serving.scheduler import LoadShedError
+        s, _ = self._sched()
+        s.note_ttft(2.0)
+        for _ in range(4):                  # queue up: est *= waves
+            s.submit(np.ones(4, np.int32), 4)
+        with pytest.raises(LoadShedError) as ei:
+            s.submit(np.ones(4, np.int32), 4, ttft_deadline_s=0.1)
+        assert ei.value.reject.reason == "deadline_infeasible"
+        assert ei.value.reject.est_ttft_s > 0.1
+
+    def test_shed_expired_deadline_in_queue(self):
+        s, t = self._sched()
+        s.submit(np.ones(4, np.int32), 4)
+        rid = s.submit(np.ones(4, np.int32), 4, ttft_deadline_s=0.5)
+        t["now"] = 1.0                      # deadline long gone
+        dead = s.shed_expired()
+        assert [r.rid for r in dead] == [rid]
+        assert len(s.queue) == 1            # the deadline-free one stays
+
+    def test_engine_reports_structured_rejects(self):
+        """Engine surface: a shed request raises LoadShedError with the
+        Reject payload, and the rejected counter ticks."""
+        model, params = _model()
+        reg = obs.MetricsRegistry()
+        eng = serving.ServingEngine(model, params, num_slots=1,
+                                    page_size=4, attn_impl="lax",
+                                    max_queue_depth=2, registry=reg)
+        eng.submit(np.ones(4, np.int32), 4)
+        eng.submit(np.ones(4, np.int32), 4)   # queue depth now 2 == cap
+        with pytest.raises(serving.LoadShedError) as ei:
+            eng.submit(np.ones(4, np.int32), 4)
+        assert ei.value.reject.reason == "queue_full"
+        assert ei.value.reject.queue_depth == 2
+        assert reg.counter("serving_rejected_total").value(
+            reason="queue_full") == 1
+        # drain so the engine ends idle
+        while not eng.scheduler.idle():
+            eng.step()
+
+    def test_engine_fifo_policy_still_available(self):
+        model, params = _model()
+        rng = np.random.default_rng(23)
+        eng = serving.ServingEngine(model, params, num_slots=2,
+                                    page_size=4, attn_impl="lax",
+                                    scheduler_policy="fifo")
+        prompts = _prompts(rng, [5, 9, 3])
+        outs = eng.generate_many(prompts, max_new_tokens=4, max_steps=200)
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(
+                o, _dense_reference(model, params, p, 4))

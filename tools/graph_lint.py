@@ -147,13 +147,44 @@ def lint_serving_decode(suppressions):
                              jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         name="serving_decode", ast_fn=eng._decode_step_impl,
+        suppressions=suppressions)
+
+
+def lint_serving_prefill(suppressions):
+    """The batched chunked-prefill step (ISSUE 6) — the other jitted
+    serving surface. Same contract as decode: the engine donates the KV
+    cache pages into the step (single-use by construction), and nothing
+    inside may sync to the host — so it must lint clean with NO
+    undonated-buffer suppression."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = serving.ServingEngine(model, params, num_slots=4, page_size=8,
+                                max_tokens_per_slot=64, attn_impl="lax")
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.prefill_step, analysis.abstractify(params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_prefill", ast_fn=eng._prefill_step_impl,
         suppressions=suppressions)
 
 
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
-                  lint_convgroup, lint_serving_decode],
+                  lint_convgroup, lint_serving_decode,
+                  lint_serving_prefill],
 }
 
 

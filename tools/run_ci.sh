@@ -33,15 +33,32 @@ $PYTEST tests/ -m "not slow"
 echo "== bench smoke (int8 dryrun) =="
 python tools/int8_bench.py --dryrun > /dev/null
 
-# serving-bench smoke: the continuous-batching engine + paged decode must
-# run end-to-end on CPU and self-validate the BENCH_SERVING schema (incl.
-# the zero-steady-state-recompiles invariant) before any TPU session
+# serving-bench smoke: the continuous-batching engine + paged decode +
+# batched prefill must run end-to-end on CPU and self-validate the
+# BENCH_SERVING schema (incl. the zero-steady-state-recompiles invariant)
+# before any TPU session; the python check pins the ISSUE 6 prefill
+# metrics — TTFT percentiles vs the stated budget and the shared-prefix
+# variant actually saving prefill work
 echo "== bench smoke (serving dryrun) =="
 SERVING_OUT="$(python bench.py --model serving --dryrun)"
 if echo "$SERVING_OUT" | grep -q '"error"'; then
   echo "serving bench dryrun failed: $SERVING_OUT"
   exit 1
 fi
+echo "$SERVING_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("ttft_p50_s", "ttft_p90_s", "ttft_p99_s", "ttft_budget_s",
+          "queue_wait_p99_s", "admit_to_first_token_p99_s",
+          "prefix_variant"):
+    assert k in r, f"BENCH_SERVING missing {k}"
+assert r["ttft_slo_met"], "dryrun TTFT p99 blew the stated budget"
+pv = r["prefix_variant"]
+assert pv["prefill_tokens_computed"] < pv["prompt_tokens_submitted"], \
+    "prefix sharing saved no prefill work"
+assert pv["recompiles"] == 0 and r["decode_recompiles_after_warmup"] == 0
+print("serving dryrun prefill metrics OK")
+'
 
 # static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
 # decode, VGG conv-group dropout) must be free of error-severity graph
