@@ -473,7 +473,19 @@ def run_bench_deepfm(dev):
     config[4]): examples/s/chip with pull/push PREFETCH overlap on, and
     the same stream with overlap off — ``vs_baseline`` is the measured
     prefetch speedup, the number behind parallel/host_kv.py's "prefetch
-    overlaps the device step" design claim."""
+    overlaps the device step" design claim.
+
+    Honest-number notes (ISSUE 7 satellite): the original loop issued
+    the next batch's dedup (np.unique over B*F ids) BEFORE dispatching
+    the device step, putting it on the critical path — prefetch then
+    measured ~0.73-0.96x (slower than sync). run_kv_epoch now issues
+    the prefetch after step dispatch, which removes the regression; on
+    an N-core CPU box with the XLA step already using every core the
+    remaining overlap is structurally ~neutral (pull threads timeshare
+    with the step — there is no idle resource to hide the pull behind,
+    unlike TPU where the device step frees the host), so the CPU
+    expectation is ~1.0x and the bench takes best-of-2 per mode to keep
+    ambient load spikes from masquerading as regressions."""
     import numpy as np
 
     from paddle_tpu import optimizer as opt
@@ -527,8 +539,11 @@ def run_bench_deepfm(dev):
         loss = float(np.mean([float(m["loss"]) for m in hist]))
         return batch * n_batches / dt, loss
 
-    eps_on, loss = timed(prefetch=True)
-    eps_off, _ = timed(prefetch=False)
+    # best-of-2 per mode: a 2-core CI box sees ambient load spikes
+    eps_on, loss = max((timed(prefetch=True) for _ in range(2)),
+                       key=lambda r: r[0])
+    eps_off, _ = max((timed(prefetch=False) for _ in range(2)),
+                     key=lambda r: r[0])
     return {
         "metric": "deepfm_examples_per_sec_per_chip",
         "value": round(eps_on, 2),
@@ -537,6 +552,11 @@ def run_bench_deepfm(dev):
         "vs_baseline": round(eps_on / max(eps_off, 1e-9), 4),
         "prefetch_speedup": round(eps_on / max(eps_off, 1e-9), 4),
         "examples_per_sec_no_prefetch": round(eps_off, 2),
+        "prefetch_note": ("cpu: step already saturates every core, so "
+                          "overlap is ~neutral by construction; the "
+                          "<1.0x regression (dedup on the critical "
+                          "path) is fixed in run_kv_epoch"
+                          if dev.platform != "tpu" else ""),
         "device": getattr(dev, "device_kind", dev.platform),
         "batch_size": batch,
         "fields": fields,
@@ -546,6 +566,218 @@ def run_bench_deepfm(dev):
                        "dt": batch * n_batches / max(eps_on, 1e-9),
                        "examples_per_step": batch},
     }
+
+
+EMBED_SERVE_SCHEMA = ("metric", "value", "unit", "vs_baseline",
+                      "qps_cached", "qps_cold", "speedup_vs_cold",
+                      "lookup_p50_s", "lookup_p99_s", "cold_batch_p99_s",
+                      "miss_pull_p99_s", "hit_rate", "evictions",
+                      "streaming_rows_applied", "staleness_seconds",
+                      "recompiles_after_warmup", "capacity", "vocab_size",
+                      "batch_size", "fields", "embed_dim", "num_batches",
+                      "device")
+
+
+def embed_serve_json_path(dryrun: bool) -> str:
+    import os
+    if dryrun:  # CI smoke must not dirty the checkout
+        return os.environ.get("PADDLE_TPU_BENCH_EMBED_SERVE",
+                              "/tmp/BENCH_EMBED_SERVE.json")
+    return os.environ.get(
+        "PADDLE_TPU_BENCH_EMBED_SERVE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_EMBED_SERVE.json"))
+
+
+def run_bench_embedding_serving(dev, dryrun=False):
+    """Online embedding serving (ISSUE 7 acceptance): DeepFM inference
+    QPS + p99 lookup latency through the device-cached
+    ``EmbeddingServingEngine`` versus the COLD full-table path — every
+    batch re-pulls the whole (vocab, dim) table from the host KV store
+    and ``device_put``s it before the forward (the no-cache way to
+    serve the same freshness guarantee when the table lives beyond
+    HBM). Traffic is zipf-ish CTR: a hot head covering most lookups
+    (the stated hit-rate regime) plus a uniform cold tail that churns
+    the LRU. A trainer thread streams row updates through the
+    StreamingUpdateChannel WHILE the cached pass serves — the online-
+    learning mix the subsystem exists for — and both paths read the
+    same store, so neither side serves stale rows beyond the engine's
+    bound. Zero steady-state recompiles is RecompileDetector-ASSERTED
+    (any retrace fails the bench), and the hit-rate / staleness gauges
+    must come out populated. ``vs_baseline`` is speedup/2.0 — 1.0 ==
+    the >=2x acceptance target. Emits BENCH_EMBED_SERVE.json (schema
+    self-validated) next to this file (dryrun: /tmp)."""
+    import numpy as np
+
+    from paddle_tpu import embedding_serving as es
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models.deepfm import DeepFMHostKV
+    from paddle_tpu.parallel.host_kv import HostKVStore
+
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        vocab, fields, dim, batch = 2_000_000, 26, 16, 2048
+        head, capacity, n_batches, min_bucket = 8192, 1 << 16, 48, 8192
+        hidden = (400, 400)
+    elif dryrun:
+        vocab, fields, dim, batch = 50_000, 8, 8, 256
+        head, capacity, n_batches, min_bucket = 512, 2048, 6, 256
+        hidden = (32,)
+    else:
+        vocab, fields, dim, batch = 200_000, 26, 8, 1024
+        head, capacity, n_batches, min_bucket = 4096, 1 << 15, 24, 4096
+        hidden = (64, 64)
+
+    model = DeepFMHostKV(num_fields=fields, embed_dim=dim, hidden=hidden)
+    params = model.init(jax.random.PRNGKey(0))
+    store = HostKVStore(1 + dim, optimizer="adagrad", init_scale=0.01,
+                        seed=0)
+    reg = obs.MetricsRegistry()
+    channel = es.StreamingUpdateChannel(store, registry=reg)
+    eng = es.EmbeddingServingEngine(
+        store, model, params, capacity=capacity, policy="lru",
+        min_bucket=min_bucket, max_pending=4, channel=channel,
+        max_staleness_s=5.0, registry=reg)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        # 80% of lookups hit the hot head, 20% the uniform cold tail —
+        # the zipf-ish CTR mix the stated hit rate comes from
+        hot = rng.integers(0, head, size=(batch, fields))
+        tail = rng.integers(head, vocab, size=(batch, fields))
+        pick = rng.random((batch, fields)) < 0.8
+        return np.where(pick, hot, tail).astype(np.int64)
+
+    batches = [make_batch() for _ in range(n_batches)]
+
+    # startup compiles: every cache gather/install bucket + the DeepFM
+    # forward per gather width; everything timed below is steady state
+    eng.warmup((batch, fields))
+    for b in batches[:2]:           # populate the hot head
+        eng.serve(b)
+    det = obs.RecompileDetector("embed_serve_bench", warmup=0,
+                                registry=reg)
+
+    def push_updates(n_rows=64):
+        ids = rng.integers(0, head, size=(n_rows,)).astype(np.int64)
+        rows = rng.normal(0, 0.01, size=(n_rows, 1 + dim)).astype(
+            np.float32)
+        channel.push_rows(ids, rows)
+
+    # --- cached pass: pipelined submit/step (miss pulls overlap the
+    # previous batch's device work), trainer pushes streaming in.
+    # Best-of-2 passes over FRESH same-distribution batches: a 2-core
+    # CI box sees ambient load spikes that would otherwise masquerade
+    # as engine regressions
+    def cached_pass():
+        # returns wall time AND this pass's own latency/staleness
+        # numbers, so the reported percentiles come from the SAME pass
+        # as the reported QPS (best-of-2 exists because ambient CI load
+        # can hit one pass — mixing pass-1 QPS with pass-2 latencies
+        # would make the artifact internally inconsistent)
+        reg.unregister("embedding_serving_lookup_seconds")
+        bs = [make_batch() for _ in range(n_batches)]
+        t0 = time.perf_counter()
+        for i, b in enumerate(bs):
+            if i % 4 == 3:
+                push_updates()
+            eng.submit(b)
+            while eng.pending() >= 2:
+                eng.step()
+        while eng.pending():
+            eng.step()
+        dt = time.perf_counter() - t0
+        lk = reg.histogram("embedding_serving_lookup_seconds")
+        return (dt, lk.quantile(0.5), lk.quantile(0.99),
+                reg.gauge("embedding_serving_staleness_seconds").value())
+
+    dt_cached, lk_p50, lk_p99, staleness = min(
+        (cached_pass() for _ in range(2)), key=lambda r: r[0])
+    det.check()
+    qps_cached = batch * n_batches / dt_cached
+    hit_rate = reg.gauge("embedding_serving_hit_rate").value()
+
+    # --- cold pass: per batch, pull the FULL table from the store,
+    # device_put it, and run the same jitted forward with feat_ids
+    # indexing the whole table (compile excluded by a warm call)
+    all_ids = np.arange(vocab, dtype=np.int64)
+    cold_fwd = jax.jit(lambda p, tbl, inv: model.predict_proba(
+        p, tbl, inv))
+    table_np = store.pull(all_ids)
+    np.asarray(cold_fwd(params, jax.device_put(table_np),
+                        jnp.asarray(batches[0].astype(np.int32))))
+
+    def cold_pass():
+        times = []
+        t0 = time.perf_counter()
+        for b in batches:
+            tb = time.perf_counter()
+            tbl = jax.device_put(store.pull(all_ids))
+            out = cold_fwd(params, tbl, jnp.asarray(b.astype(np.int32)))
+            np.asarray(out)
+            times.append(time.perf_counter() - tb)
+        return time.perf_counter() - t0, times
+
+    dt_cold, cold_times = min((cold_pass() for _ in range(2)),
+                              key=lambda r: r[0])
+    qps_cold = batch * n_batches / dt_cold
+
+    channel.flush()
+    speedup = qps_cached / max(qps_cold, 1e-9)
+    result = {
+        "metric": "embedding_serving_examples_per_sec",
+        "value": round(qps_cached, 2),
+        "unit": "examples/s",
+        "vs_baseline": round(speedup / 2.0, 4),  # 1.0 == the 2x target
+        "qps_cached": round(qps_cached, 2),
+        "qps_cold": round(qps_cold, 2),
+        "speedup_vs_cold": round(speedup, 4),
+        "lookup_p50_s": round(lk_p50, 6),
+        "lookup_p99_s": round(lk_p99, 6),
+        "cold_batch_p99_s": round(float(np.percentile(cold_times, 99)),
+                                  6),
+        "miss_pull_p99_s": round(reg.histogram(
+            "embedding_serving_miss_latency_seconds").quantile(0.99), 6),
+        "hit_rate": round(hit_rate, 4),
+        "evictions": int(reg.counter(
+            "embedding_cache_evictions_total").value()),
+        "streaming_rows_applied": int(reg.counter(
+            "embedding_stream_rows_applied_total").value()),
+        "staleness_seconds": round(staleness, 6),
+        "recompiles_after_warmup": det.recompiles,
+        "capacity": capacity,
+        "vocab_size": vocab,
+        "batch_size": batch,
+        "fields": fields,
+        "embed_dim": dim,
+        "num_batches": n_batches,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "dryrun": bool(dryrun),
+        "_telemetry": {"steps": n_batches, "dt": dt_cached,
+                       "examples_per_step": batch},
+    }
+    missing = [k for k in EMBED_SERVE_SCHEMA if k not in result]
+    if missing:
+        raise RuntimeError(f"BENCH_EMBED_SERVE schema self-check "
+                           f"failed: missing {missing}")
+    if result["recompiles_after_warmup"] != 0:
+        raise RuntimeError(
+            f"steady-state embedding serving recompiled "
+            f"{det.recompiles}x — fixed-shape invariant broken (a "
+            "gather/install/forward bucket missed by warmup)")
+    if not 0.0 < result["hit_rate"] <= 1.0:
+        raise RuntimeError(
+            f"hit-rate gauge not populated: {result['hit_rate']}")
+    if result["streaming_rows_applied"] <= 0:
+        raise RuntimeError("streaming channel applied no rows — the "
+                           "online-update half of the bench is dead")
+    path = embed_serve_json_path(dryrun)
+    with open(path, "w") as f:
+        json.dump({k: v for k, v in result.items()
+                   if k != "_telemetry"}, f, indent=2)
+    result["bench_json"] = path
+    return result
 
 
 SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
@@ -945,6 +1177,9 @@ _BENCHES = {
                "examples/s/chip"),
     "serving": (run_bench_serving, "serving_decode_tokens_per_sec",
                 "tokens/s"),
+    "embedding_serving": (run_bench_embedding_serving,
+                          "embedding_serving_examples_per_sec",
+                          "examples/s"),
 }
 
 
@@ -962,8 +1197,10 @@ def main():
         from paddle_tpu import observability as obs
         obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
-        if which == "serving":  # CI smoke: tiny sizes + schema self-check
-            result = run_bench_serving(dev, dryrun="--dryrun" in sys.argv)
+        if which in ("serving", "embedding_serving"):
+            # CI smoke: tiny sizes + schema self-check
+            result = _BENCHES[which][0](dev,
+                                        dryrun="--dryrun" in sys.argv)
         else:
             result = _BENCHES[which][0](dev)
         if degraded:  # zero BEFORE telemetry so JSONL/.prom agree with stdout

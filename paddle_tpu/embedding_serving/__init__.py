@@ -1,0 +1,50 @@
+"""Online embedding serving: device-cached host-KV lookups with
+streaming updates.
+
+The serving half of the parameter-server world (ROADMAP item 4 — the
+ads/recsys production loop): millions of sparse embedding rows live in
+a host/remote KV store; inference pulls hot rows through a fixed-shape
+device cache; online-learning pushes from a trainer serve within a
+bounded staleness window. Four parts:
+
+1. **Device hot-row cache** (`device_cache.py`):
+   :class:`DeviceEmbeddingCache` — an HBM table (capacity ≪ vocab) with
+   a host id→slot index, ONE pow2-bucketed fixed-shape gather per
+   lookup and ONE bucketed donated scatter per install, LRU/LFU
+   eviction, ``warmup()`` ⇒ zero steady-state recompiles.
+2. **Streaming updates** (`streaming.py`):
+   :class:`StreamingUpdateChannel` — an AsyncCommunicator-style
+   trainer→server push channel (merged background applies, value or
+   gradient pushes) with per-row version counters; pushed rows refresh
+   cached device slots on their next lookup, and channel lag (seconds
+   and updates behind) is the observable, engine-enforced staleness
+   bound.
+3. **Serving engine** (`engine.py`): :class:`EmbeddingServingEngine` —
+   ``submit``/``step``/``serve`` batches of sparse ids → dense rows →
+   DeepFM probabilities, miss pulls ``pull_async``-overlapped with
+   device work, structured :class:`EmbeddingLoadShedError` rejects when
+   the miss pipeline saturates, hit-rate/staleness/miss-latency metrics
+   in the observability registry.
+4. **Persistence** (`persistence.py`): manifest-committed, sha256-
+   verified KV-table snapshots (the resilience discipline) including
+   the streaming version counters.
+"""
+
+from paddle_tpu.embedding_serving.device_cache import (CacheCapacityError,
+                                                       DeviceEmbeddingCache)
+from paddle_tpu.embedding_serving.streaming import StreamingUpdateChannel
+from paddle_tpu.embedding_serving.engine import (EmbeddingLoadShedError,
+                                                 EmbeddingServingEngine,
+                                                 EmbedReject)
+from paddle_tpu.embedding_serving.persistence import (committed_steps,
+                                                      latest_valid_step,
+                                                      restore_kv_snapshot,
+                                                      save_kv_snapshot)
+
+__all__ = [
+    "CacheCapacityError", "DeviceEmbeddingCache",
+    "StreamingUpdateChannel",
+    "EmbeddingLoadShedError", "EmbeddingServingEngine", "EmbedReject",
+    "committed_steps", "latest_valid_step", "restore_kv_snapshot",
+    "save_kv_snapshot",
+]

@@ -1,0 +1,362 @@
+"""Device-resident hot-row cache over a beyond-HBM embedding table.
+
+The serving half of the parameter-server world: the full sparse table
+(millions of ids) lives in a host/remote KV store; the device holds a
+fixed-shape HBM table of ``capacity`` hot rows plus a host-side id→slot
+index. A batch lookup becomes
+
+  uniq ids  ->  split into resident hits / misses (host dict probes)
+  misses    ->  pulled from the backing store, installed via ONE
+                bucketed scatter (``table.at[slots].set(rows)``,
+                table DONATED — the update step never copies HBM)
+  all uniq  ->  ONE bucketed fixed-shape gather (``take``) returning
+                the padded (U_pad, dim) rows the model consumes
+
+Both the scatter and the gather run at pow2-bucketed widths, so the
+number of compiled shapes is O(log max_batch_uniq) and a ``warmup()``
+precompiles them all — steady-state serving triggers zero recompiles
+(RecompileDetector-asserted by tests and the bench, exactly like the
+token-serving engine).
+
+Slot 0 is a reserved NULL slot: gather padding lanes read it and
+scatter padding lanes write it, so ragged real counts never change a
+compiled shape. Its contents are scratch — no real id ever maps to it.
+
+Host-side cost scales with ids, not python statements: the id→slot map
+is one dict maintained with C-level ``update(zip(...))`` bulk ops, and
+the eviction policy (``lru`` = least-recently served, ``lfu`` = least
+frequently served with LRU tiebreak) lives in slot-indexed numpy
+arrays — touching a 10k-id batch is two vectorized writes, and victim
+selection is one argsort over used slots. (The first cut kept an
+OrderedDict per id; at ~9k uniq ids/batch its per-id bookkeeping cost
+more than the entire KV pull it was saving.)
+
+Pure device+index structure: no store dependency — the
+:class:`~paddle_tpu.embedding_serving.engine.EmbeddingServingEngine`
+mediates pulls/pushes, which keeps this class unit-testable (and
+lintable) without the native KV library.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+
+def _pow2_bucket(n: int, minimum: int, cap: int) -> int:
+    b = max(int(minimum), 1)
+    while b < n:
+        b *= 2
+    # round the cap itself up to a power of two: clamping to a raw
+    # (possibly non-pow2) capacity would mint a serve-time width that
+    # warmup()'s doubling loop never compiled
+    c = 1
+    while c < cap:
+        c *= 2
+    return min(b, c)
+
+
+class CacheCapacityError(RuntimeError):
+    """A single batch references more unique ids than the device table
+    can hold — the fixed-shape gather cannot serve it. Size ``capacity``
+    to at least the per-batch unique-id high-water mark."""
+
+
+class DeviceEmbeddingCache:
+    """Fixed-shape HBM hot-row table + host id→slot index.
+
+    ``capacity`` device rows (plus the null slot), ``dim`` floats each.
+    The jitted update step donates the table, so installs mutate HBM in
+    place; the gather step only reads it.
+    """
+
+    def __init__(self, capacity: int, dim: int, *, dtype=None,
+                 policy: str = "lru", min_gather_bucket: int = 256,
+                 min_install_bucket: int = 8, registry=None):
+        import jax
+        import jax.numpy as jnp
+
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if policy not in ("lru", "lfu"):
+            raise ValueError(f"policy must be 'lru' or 'lfu', "
+                             f"got {policy!r}")
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.policy = policy
+        self.min_gather_bucket = int(min_gather_bucket)
+        self.min_install_bucket = int(min_install_bucket)
+        self.dtype = dtype or jnp.float32
+        # slot 0 = null; real slots 1..capacity
+        self.table = jnp.zeros((self.capacity + 1, self.dim), self.dtype)
+        self._slot_of: Dict[int, int] = {}
+        self._id_of = np.full((self.capacity + 1,), -1, np.int64)
+        self._free = list(range(self.capacity, 0, -1))  # pop() -> slot 1 last
+        # slot-indexed policy books (vectorized touch/evict)
+        self._slot_last = np.full((self.capacity + 1,), -1, np.int64)
+        self._slot_freq = np.zeros((self.capacity + 1,), np.int64)
+        self._tick = 0
+        self._version: Dict[int, int] = {}
+
+        self._gather_fn = jax.jit(
+            lambda table, slots: jnp.take(table, slots, axis=0))
+        self._install_fn = jax.jit(
+            lambda table, slots, rows: table.at[slots].set(rows),
+            donate_argnums=(0,))
+
+        from paddle_tpu import observability as obs
+        self._reg = registry or obs.default()
+        self._hits = self._reg.counter(
+            "embedding_cache_hits_total", "id lookups served from HBM")
+        self._misses = self._reg.counter(
+            "embedding_cache_misses_total",
+            "id lookups that pulled from the store")
+        self._evictions = self._reg.counter(
+            "embedding_cache_evictions_total", "rows evicted from HBM")
+
+    # -- index ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    def resident(self, id_: int) -> bool:
+        return int(id_) in self._slot_of
+
+    def version_of(self, id_: int) -> Optional[int]:
+        """Version recorded when ``id_``'s row was installed (None when
+        not resident)."""
+        return self._version.get(int(id_))
+
+    def split(self, uniq_ids: np.ndarray,
+              current_versions: Optional[Dict[int, int]] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Partition ``uniq_ids`` into (hit_mask, miss_ids). A resident
+        row whose installed version is behind ``current_versions[id]``
+        counts as a MISS (stale — streaming refresh path): the caller
+        re-pulls it and ``install`` overwrites the same slot."""
+        ids = uniq_ids.tolist()
+        probe = self._slot_of
+        if current_versions is None:
+            hit = np.fromiter((i in probe for i in ids), bool,
+                              uniq_ids.size)
+        else:
+            ver = self._version
+            hit = np.fromiter(
+                ((i in probe
+                  and ver.get(i, 0) >= current_versions.get(i, 0))
+                 for i in ids), bool, uniq_ids.size)
+        return hit, uniq_ids[~hit]
+
+    # -- eviction ---------------------------------------------------------
+
+    def _victim_slots(self, n: int, protect: set) -> np.ndarray:
+        """Slots of the ``n`` policy-best eviction victims, never
+        touching ``protect``-ed ids. LRU uses argpartition over the
+        slot-tick array (protected ids are recently served, so they
+        rarely land in the oldest-n window and the first window almost
+        always suffices); LFU pays one lexsort. No per-id python
+        bookkeeping beyond the protection probe on candidates."""
+        cand = np.flatnonzero(self._id_of >= 0)
+        if self.policy == "lfu":
+            order = cand[np.lexsort((self._slot_last[cand],
+                                     self._slot_freq[cand]))]
+            ids = self._id_of[order]
+            keep = np.fromiter((int(i) not in protect
+                                for i in ids.tolist()), bool, ids.size)
+            sel = order[keep][:n]
+            if sel.size < n:
+                raise CacheCapacityError(
+                    f"need {n} free slots but only {sel.size} evictable "
+                    f"(capacity {self.capacity}, protected "
+                    f"{len(protect)}) — batch uniq ids exceed capacity")
+            return sel
+        k = min(n + 256, cand.size)
+        while True:
+            part = cand[np.argpartition(self._slot_last[cand],
+                                        k - 1)[:k]] \
+                if k < cand.size else cand
+            part = part[np.argsort(self._slot_last[part],
+                                   kind="stable")]   # oldest first
+            ids = self._id_of[part]
+            keep = np.fromiter((int(i) not in protect
+                                for i in ids.tolist()), bool, ids.size)
+            sel = part[keep][:n]
+            if sel.size >= n:
+                return sel
+            if k >= cand.size:
+                raise CacheCapacityError(
+                    f"need {n} free slots but only {sel.size} evictable "
+                    f"(capacity {self.capacity}, protected "
+                    f"{len(protect)}) — batch uniq ids exceed capacity")
+            k = min(k * 2, cand.size)
+
+    def invalidate(self, ids: np.ndarray) -> int:
+        """Drop ids from the device index (their next lookup is a miss).
+        The HBM rows are left as garbage in now-free slots — unreachable
+        through the index, so never served. Returns rows dropped."""
+        dropped = []
+        for id_ in np.asarray(ids, np.int64).ravel().tolist():
+            slot = self._slot_of.pop(id_, None)
+            if slot is None:
+                continue
+            dropped.append(slot)
+            self._version.pop(id_, None)
+        if dropped:
+            s = np.asarray(dropped, np.int64)
+            self._id_of[s] = -1
+            self._slot_last[s] = -1
+            self._slot_freq[s] = 0
+            self._free.extend(s.tolist())
+        return len(dropped)
+
+    # -- update / serve ---------------------------------------------------
+
+    def install(self, miss_ids: np.ndarray, rows: np.ndarray,
+                versions: Optional[Dict[int, int]] = None,
+                protect: Optional[Iterable[int]] = None):
+        """Write pulled rows into HBM via one bucketed donated scatter.
+        Already-resident ids are refreshed in their existing slot; new
+        ids take free slots, evicting policy victims (never ``protect``,
+        defaulting to the install set itself) when none are free."""
+        import jax.numpy as jnp
+
+        miss_ids = np.asarray(miss_ids, np.int64).ravel()
+        if miss_ids.size == 0:
+            return
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.shape[0] < miss_ids.size or rows.shape[1] != self.dim:
+            raise ValueError(f"rows {rows.shape} cannot cover "
+                             f"({miss_ids.size}, {self.dim})")
+        ids = miss_ids.tolist()
+        slots = np.fromiter((self._slot_of.get(i, 0) for i in ids),
+                            np.int64, miss_ids.size)
+        fresh = slots == 0              # not resident yet
+        need = int(fresh.sum())
+        short = need - len(self._free)
+        if short > 0:
+            # evict by reassignment: pop the victims' index entries and
+            # hand their slots straight to the new ids — no free-list
+            # round trip, dict surgery only (C-level bulk ops)
+            vslots = self._victim_slots(
+                short, set(ids) | set(int(p) for p in (protect or ())))
+            spop, vpop = self._slot_of.pop, self._version.pop
+            for old in self._id_of[vslots].tolist():
+                spop(old)
+                vpop(old, None)
+            self._free.extend(vslots.tolist())
+            self._id_of[vslots] = -1
+            self._slot_last[vslots] = -1
+            self._slot_freq[vslots] = 0
+            self._evictions.inc(int(vslots.size))
+        if need:
+            new_slots = np.asarray(self._free[-need:], np.int64)
+            del self._free[-need:]
+            new_ids = miss_ids[fresh]
+            slots[fresh] = new_slots
+            self._slot_of.update(
+                zip(new_ids.tolist(), new_slots.tolist()))
+            self._id_of[new_slots] = new_ids
+            self._slot_freq[new_slots] = 0
+        self._tick += 1
+        self._slot_last[slots] = self._tick
+        if versions is not None:
+            self._version.update(
+                (i, versions.get(i, 0)) for i in ids)
+        else:
+            ver = self._version
+            self._version.update((i, ver.get(i, 0)) for i in ids)
+        b = _pow2_bucket(miss_ids.size, self.min_install_bucket,
+                         max(self.capacity, miss_ids.size))
+        slots_p = np.zeros((b,), np.int32)            # pad -> null slot
+        rows_p = np.zeros((b, self.dim), np.float32)
+        slots_p[:miss_ids.size] = slots
+        rows_p[:miss_ids.size] = rows[:miss_ids.size]
+        self.table = self._install_fn(self.table, jnp.asarray(slots_p),
+                                      jnp.asarray(rows_p, self.dtype))
+
+    def gather(self, uniq_ids: np.ndarray, *,
+               pad_to: Optional[int] = None):
+        """One fixed-shape gather of every id's row. Every id must be
+        resident (``install`` misses first). Returns a device array
+        (U_pad, dim); padding lanes read the null slot (contents
+        scratch — the model's ``inv`` indices never point at them).
+        Also advances the eviction policy (serve == touch)."""
+        import jax.numpy as jnp
+
+        uniq_ids = np.asarray(uniq_ids, np.int64).ravel()
+        b = pad_to or _pow2_bucket(uniq_ids.size, self.min_gather_bucket,
+                                   max(self.capacity, uniq_ids.size))
+        if uniq_ids.size > b:
+            raise CacheCapacityError(
+                f"{uniq_ids.size} uniq ids > gather width {b}")
+        try:
+            used = np.fromiter(
+                (self._slot_of[i] for i in uniq_ids.tolist()),
+                np.int64, uniq_ids.size)
+        except KeyError as e:
+            raise KeyError(
+                f"id {e.args[0]} not resident (install first)") from None
+        self._tick += 1
+        self._slot_last[used] = self._tick
+        self._slot_freq[used] += 1      # uniq ids: no duplicate slots
+        slots = np.zeros((b,), np.int32)
+        slots[:used.size] = used
+        return self._gather_fn(self.table, jnp.asarray(slots))
+
+    def note_traffic(self, hits: int, misses: int):
+        self._hits.inc(hits)
+        self._misses.inc(misses)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def warmup(self, max_uniq: int):
+        """Precompile every gather and install bucket a batch with up to
+        ``max_uniq`` unique ids can hit (all against the null slot — no
+        live rows are touched), so steady-state lookups compile
+        nothing."""
+        import jax.numpy as jnp
+
+        cap = max(self.capacity, int(max_uniq))
+        for minimum, fn, mk in (
+                (self.min_gather_bucket, self._gather_fn,
+                 lambda b: (self.table, jnp.zeros((b,), jnp.int32))),
+                (self.min_install_bucket, self._install_fn,
+                 lambda b: (self.table, jnp.zeros((b,), jnp.int32),
+                            jnp.zeros((b, self.dim), self.dtype)))):
+            b = max(minimum, 1)
+            while True:
+                out = fn(*mk(b))
+                if fn is self._install_fn:
+                    self.table = out
+                if b >= _pow2_bucket(int(max_uniq), minimum, cap):
+                    break
+                b *= 2
+
+    def check_invariants(self):
+        """Index consistency (the property test's spine): id→slot and
+        slot→id are inverse bijections, free+used partition the slots,
+        the null slot is never mapped, and the policy/version books
+        cover exactly the resident set."""
+        used = set(self._slot_of.values())
+        assert 0 not in used, "null slot mapped to a real id"
+        assert len(used) == len(self._slot_of), "two ids share a slot"
+        free = set(self._free)
+        assert not (used & free), "slot both free and used"
+        assert used | free == set(range(1, self.capacity + 1)), \
+            "slots leaked"
+        for id_, slot in self._slot_of.items():
+            assert self._id_of[slot] == id_, "reverse index mismatch"
+        assert set(np.flatnonzero(self._id_of >= 0).tolist()) == used, \
+            "slot->id book out of sync"
+        assert (self._slot_last[sorted(used)] >= 0).all() if used \
+            else True, "used slot without a policy tick"
+        assert (self._slot_last[sorted(free)] == -1).all() if free \
+            else True, "free slot with a live policy tick"
+        assert set(self._version) == set(self._slot_of), \
+            "version book out of sync"
+
+    def hit_ratio_window(self) -> float:
+        h = self._hits.value()
+        m = self._misses.value()
+        return h / max(h + m, 1.0)

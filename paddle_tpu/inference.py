@@ -228,6 +228,22 @@ def make_serving_engine(model, params, **kwargs):
     return _serving.ServingEngine(model, params, **kwargs)
 
 
+def make_embedding_serving_engine(store, model=None, params=None,
+                                  **kwargs):
+    """Online embedding-lookup serving front end — the sparse/recsys
+    sibling of :func:`make_serving_engine`. Builds a
+    :class:`paddle_tpu.embedding_serving.EmbeddingServingEngine` over a
+    host/remote KV backing store: ``submit()`` batches of sparse ids,
+    drive ``step()`` (or call ``serve()``), and hot rows come from a
+    fixed-shape device cache (misses pulled async and installed with
+    LRU/LFU eviction) while trainer pushes stream in through a
+    :class:`~paddle_tpu.embedding_serving.StreamingUpdateChannel` under
+    an enforced staleness bound — hit-rate, staleness, miss-latency and
+    eviction metrics land in the observability registry."""
+    from paddle_tpu import embedding_serving as _es
+    return _es.EmbeddingServingEngine(store, model, params, **kwargs)
+
+
 class Predictor:
     """Zero-copy-ish serving wrapper over an exported model.
 

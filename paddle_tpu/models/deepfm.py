@@ -108,3 +108,11 @@ class DeepFMHostKV(_DeepFMTowers):
 
     def loss(self, params, rows, inv, label, feat_vals=None):
         return self._loss(self.forward(params, rows, inv, feat_vals), label)
+
+    def predict_proba(self, params, rows, inv, feat_vals=None):
+        """Serving forward: (B,) click probabilities from pulled rows.
+        The embedding-serving engine jits this per row-bucket width —
+        ``rows`` may carry trailing padding lanes (``inv`` never points
+        at them), so one compiled shape serves any batch whose unique
+        ids fit the bucket."""
+        return jax.nn.sigmoid(self.forward(params, rows, inv, feat_vals))

@@ -83,6 +83,11 @@ class HostKVStore:
     first pull with deterministic per-id init (uniform ±init_scale).
     """
 
+    # class-level defaults so a partially-constructed instance (native
+    # build/load failed mid-__init__) still tears down cleanly
+    _h = None
+    _lib = None
+
     def __init__(self, dim: int, *, optimizer: str = "adagrad",
                  init_scale: float = 0.01, seed: int = 0,
                  num_shards: int = 64, num_threads: int = 8):
@@ -91,9 +96,17 @@ class HostKVStore:
         self.optimizer = optimizer
         self._h = self._lib.kv_create(
             self.dim, _OPT_NAMES[optimizer], float(init_scale), int(seed),
-            int(num_shards), int(num_threads))
+            int(num_shards), int(num_threads)) or None
         if not self._h:
             raise RuntimeError("kv_create failed")
+
+    def _handle(self):
+        """Native handle, or a clean Python error after close() — a
+        NULL handle handed to ctypes would segfault in native code."""
+        h = self._h
+        if h is None:
+            raise RuntimeError("HostKVStore is closed")
+        return h
 
     def pull(self, ids: np.ndarray, out: Optional[np.ndarray] = None
              ) -> np.ndarray:
@@ -105,7 +118,7 @@ class HostKVStore:
             out = np.empty((ids.size, self.dim), np.float32)
         else:
             self._check_out(ids, out)
-        self._lib.kv_pull(self._h, _i64p(ids), ids.size, _f32p(out))
+        self._lib.kv_pull(self._handle(), _i64p(ids), ids.size, _f32p(out))
         return out[:ids.size]
 
     def pull_async(self, ids: np.ndarray,
@@ -115,8 +128,8 @@ class HostKVStore:
             out = np.empty((ids.size, self.dim), np.float32)
         else:
             self._check_out(ids, out)
-        ticket = self._lib.kv_pull_async(self._h, _i64p(ids), ids.size,
-                                         _f32p(out))
+        ticket = self._lib.kv_pull_async(self._handle(), _i64p(ids),
+                                         ids.size, _f32p(out))
         return PullHandle(self, ticket, ids, out)
 
     def _check_out(self, ids, out):
@@ -135,11 +148,11 @@ class HostKVStore:
             raise ValueError(f"grads shape {grads.shape} != "
                              f"({ids.size}, {self.dim})")
         if wait:
-            self._lib.kv_push(self._h, _i64p(ids), ids.size, _f32p(grads),
-                              float(lr))
+            self._lib.kv_push(self._handle(), _i64p(ids), ids.size,
+                              _f32p(grads), float(lr))
         else:
             # native copies the buffers; applied by pool threads
-            self._lib.kv_push_async(self._h, _i64p(ids), ids.size,
+            self._lib.kv_push_async(self._handle(), _i64p(ids), ids.size,
                                     _f32p(grads), float(lr))
 
     def set_rows(self, ids: np.ndarray, vals: np.ndarray):
@@ -148,31 +161,45 @@ class HostKVStore:
         if vals.shape != (ids.size, self.dim):
             raise ValueError(f"vals shape {vals.shape} != "
                              f"({ids.size}, {self.dim})")
-        self._lib.kv_set_rows(self._h, _i64p(ids), ids.size, _f32p(vals))
+        self._lib.kv_set_rows(self._handle(), _i64p(ids), ids.size,
+                              _f32p(vals))
 
     def flush(self):
         """Barrier for all outstanding async pulls/pushes."""
-        self._lib.kv_flush(self._h)
+        self._lib.kv_flush(self._handle())
 
     def __len__(self):
-        return int(self._lib.kv_size(self._h))
+        return int(self._lib.kv_size(self._handle()))
 
     def save(self, path: str):
         self.flush()
-        if self._lib.kv_save(self._h, str(path).encode()) != 0:
+        if self._lib.kv_save(self._handle(), str(path).encode()) != 0:
             raise IOError(f"kv_save({path}) failed")
 
     def load(self, path: str):
-        if self._lib.kv_load(self._h, str(path).encode()) != 0:
+        if self._lib.kv_load(self._handle(), str(path).encode()) != 0:
             raise IOError(f"kv_load({path}) failed (dim/optimizer mismatch "
                           "or unreadable file)")
 
+    def close(self):
+        """Idempotent teardown: flush outstanding async ops and destroy
+        the native table. Safe to call repeatedly, and safe on a store
+        whose native library never loaded (``_lib()`` raised mid-
+        ``__init__``) — the interpreter-exit ``__del__`` path must not
+        spew AttributeErrors over a half-built instance."""
+        h, self._h = getattr(self, "_h", None), None
+        lib = getattr(self, "_lib", None)
+        if h and lib is not None:
+            try:
+                lib.kv_flush(h)
+            finally:
+                lib.kv_destroy(h)
+
     def __del__(self):
-        h = getattr(self, "_h", None)
-        if h:
-            self._lib.kv_flush(h)
-            self._lib.kv_destroy(h)
-            self._h = None
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: never raise from __del__
 
 
 class PullHandle:
@@ -190,7 +217,9 @@ class PullHandle:
 
     def wait(self) -> np.ndarray:
         if not self._done:
-            self._store._lib.kv_wait(self._store._h, self._ticket)
+            h = self._store._h
+            if h is not None:       # closed store already flushed
+                self._store._lib.kv_wait(h, self._ticket)
             self._done = True
         return self._out
 
@@ -348,14 +377,20 @@ def run_kv_epoch(step_fn, state, emb: HostKVEmbedding, batches,
             # this batch's pull was issued last iteration (or is the first)
             sb = pf.wait() if pf is not None \
                 else emb.lookup_batch(batch[ids_key])
-            if nxt is not None:
-                pf = emb.prefetch_batch(nxt[ids_key])
         else:
             # strictly synchronous: pull AFTER the previous push landed
             sb = emb.lookup_batch(batch[ids_key])
         feed = {k: v for k, v in batch.items() if k != ids_key}
         state, grad_rows, metrics = step_fn(
             state, sb.rows, inv=sb.inv, **feed)
+        if prefetch and nxt is not None:
+            # issue the NEXT batch's dedup + pull only after this step
+            # is dispatched: jax dispatch is async, so the np.unique
+            # sort AND the C++ pull threads both run while the device
+            # executes — issuing before dispatch (the old order) left
+            # the dedup serial on the critical path, which on small
+            # steps cost more than the overlap won back
+            pf = emb.prefetch_batch(nxt[ids_key])
         emb.apply_grads(sb, _np.asarray(grad_rows), wait=not async_push)
         history.append(metrics)
         batch = nxt if prefetch else next(it, None)

@@ -48,6 +48,11 @@ def _lib():
 class KVServer:
     """In-process handle on a serving pserver (native accept loop)."""
 
+    # class-level defaults: a partially-constructed server (native
+    # build/load failed mid-__init__) must still stop() cleanly
+    _h = None
+    _lib = None
+
     def __init__(self, dim: int, *, optimizer: str = "adagrad",
                  init_scale: float = 0.01, seed: int = 0,
                  num_shards: int = 64, num_threads: int = 8,
@@ -55,16 +60,19 @@ class KVServer:
         self._lib = _lib()
         self._h = self._lib.kvs_start(
             dim, _OPT_NAMES[optimizer], float(init_scale), int(seed),
-            int(num_shards), int(num_threads), int(port))
+            int(num_shards), int(num_threads), int(port)) or None
         if not self._h:
             raise RuntimeError("kv server failed to start")
         self.dim = dim
         self.port = int(self._lib.kvs_port(self._h))
 
     def stop(self):
-        if getattr(self, "_h", None):
-            self._lib.kvs_stop(self._h)
-            self._h = None
+        """Idempotent shutdown; safe when the native library never
+        loaded (no AttributeError spew at interpreter exit)."""
+        h, self._h = getattr(self, "_h", None), None
+        lib = getattr(self, "_lib", None)
+        if h and lib is not None:
+            lib.kvs_stop(h)
 
     def __del__(self):
         try:

@@ -181,10 +181,52 @@ def lint_serving_prefill(suppressions):
         suppressions=suppressions)
 
 
+def lint_embedding_install(suppressions):
+    """The embedding-serving cache's update step: the device hot-row
+    table is DONATED into the bucketed scatter (the engine replaces its
+    table handle every install — single-use by construction), so this
+    must lint clean with NO undonated-buffer suppression."""
+    from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+
+    cache = DeviceEmbeddingCache(64, 9, min_gather_bucket=8)
+    return analysis.lint_fn(
+        cache._install_fn, analysis.abstractify(cache.table),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((8, 9), jnp.float32),
+        name="embedding_cache_install", suppressions=suppressions)
+
+
+def lint_embedding_lookup(suppressions):
+    """The embedding-serving hot path: fixed-shape gather out of the
+    (read-only) device table straight into the DeepFM forward. Nothing
+    inside may sync to the host (no callbacks, no .item()) — misses are
+    handled host-side BEFORE this step runs, which is exactly what
+    keeps the jitted surface clean."""
+    from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+    from paddle_tpu.models.deepfm import DeepFMHostKV
+
+    cache = DeviceEmbeddingCache(64, 9, min_gather_bucket=8)
+    model = DeepFMHostKV(num_fields=4, embed_dim=8, hidden=(16,))
+    params = model.init(jax.random.PRNGKey(0))
+
+    def serve(params, table, slots, inv):
+        rows = jnp.take(table, slots, axis=0)
+        return model.predict_proba(params, rows, inv)
+
+    return analysis.lint_fn(
+        jax.jit(serve), analysis.abstractify(params),
+        analysis.abstractify(cache.table),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((4, 4), jnp.int32),
+        name="embedding_lookup_serve", ast_fn=serve,
+        suppressions=suppressions)
+
+
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
                   lint_convgroup, lint_serving_decode,
-                  lint_serving_prefill],
+                  lint_serving_prefill, lint_embedding_install,
+                  lint_embedding_lookup],
 }
 
 

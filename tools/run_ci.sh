@@ -60,6 +60,31 @@ assert pv["recompiles"] == 0 and r["decode_recompiles_after_warmup"] == 0
 print("serving dryrun prefill metrics OK")
 '
 
+# embedding-serving bench smoke: the device-cached host-KV lookup engine
+# must run end-to-end on CPU (cache hits/misses/evictions, streaming
+# pushes, zero steady-state recompiles) and self-validate the
+# BENCH_EMBED_SERVE schema before any TPU session
+echo "== bench smoke (embedding serving dryrun) =="
+EMBED_OUT="$(python bench.py --model embedding_serving --dryrun)"
+if echo "$EMBED_OUT" | grep -q '"error"'; then
+  echo "embedding serving bench dryrun failed: $EMBED_OUT"
+  exit 1
+fi
+echo "$EMBED_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("qps_cached", "qps_cold", "speedup_vs_cold", "lookup_p99_s",
+          "hit_rate", "staleness_seconds", "streaming_rows_applied",
+          "evictions", "recompiles_after_warmup"):
+    assert k in r, f"BENCH_EMBED_SERVE missing {k}"
+assert r["recompiles_after_warmup"] == 0, "steady-state recompile"
+assert 0.0 < r["hit_rate"] <= 1.0, "hit-rate gauge not populated"
+assert r["streaming_rows_applied"] > 0, "streaming updates dead"
+assert r["speedup_vs_cold"] > 1.0, \
+    "device cache slower than the cold full-table path"
+print("embedding serving dryrun metrics OK")
+'
+
 # static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
 # decode, VGG conv-group dropout) must be free of error-severity graph
 # hazards (host syncs, key reuse, tracer branches); accepted warnings
