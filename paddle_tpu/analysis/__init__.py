@@ -8,30 +8,54 @@ buffers doubling peak HBM, reused PRNG keys, replicated multi-GB params
 runs. This package is the ahead-of-time complement to the observability
 subsystem's runtime ``RecompileDetector``:
 
-- :mod:`~paddle_tpu.analysis.jaxpr_lint` — walks the closed jaxpr
-  (through pjit/scan/while/cond) for host callbacks, f64 promotions,
-  missed donation, PRNG key reuse, and plan-degenerate replication.
-- :mod:`~paddle_tpu.analysis.ast_lint` — reads step-function source for
-  host-sync idioms (``.item()``, ``np.asarray``, ``time.time()``, stdlib
-  ``random``) and Python branches on tracer values.
+The analysis runs in three tiers, one per program representation:
+
+- :mod:`~paddle_tpu.analysis.ast_lint` — reads step-function *source*
+  for host-sync idioms (``.item()``, ``np.asarray``, ``time.time()``,
+  stdlib ``random``) and Python branches on tracer values.
+- :mod:`~paddle_tpu.analysis.jaxpr_lint` — walks the closed *jaxpr*
+  (through pjit/scan/while/cond/remat) for host callbacks, f64
+  promotions, missed donation, PRNG key reuse, and plan-degenerate
+  replication.
+- :mod:`~paddle_tpu.analysis.cost_model` +
+  :mod:`~paddle_tpu.analysis.hlo_lint` — lower to *StableHLO* and walk
+  the module: per-op flops/bytes, a liveness-based peak-HBM estimate,
+  per-collective accounting (:class:`CostReport`), and the HLO-tier
+  rules — unexpected collectives, resharding churn, peak-HBM budgets,
+  and the bucket-coverage proof that serving ``warmup()`` precompiles
+  every reachable pow2 signature.
 - :mod:`~paddle_tpu.analysis.findings` — the reporting spine: structured
   :class:`Finding` records, text/JSON rendering, registry counting, and
-  committed :class:`Suppressions` for CI.
+  committed :class:`Suppressions` for CI (with stale-entry detection).
 
-Entry points: :func:`lint_fn` / :func:`lint_train_step` here,
-``Trainer.fit(lint='warn'|'error'|'off')``, ``Executor(lint=...)``, and
-the ``tools/graph_lint.py`` CLI over the model zoo.
+Entry points: :func:`lint_fn` / :func:`lint_train_step` here (pass
+``cost=True`` or any budget option for the HLO tier),
+``Trainer.fit(lint='warn'|'error'|'off', lint_cost=...)``,
+``Executor(lint=..., lint_cost=...)``, and the ``tools/graph_lint.py``
+CLI over the model zoo (``--cost`` / ``--cost-diff`` gate the committed
+``tools/cost_budgets.json`` budgets in CI — a perf-regression gate that
+needs no hardware).
 """
 
 from paddle_tpu.analysis.api import (LINT_MODES, LintError, abstractify,
                                      enforce, lint_fn, lint_train_step)
 from paddle_tpu.analysis.ast_lint import lint_callable, lint_source
+from paddle_tpu.analysis.cost_model import (CostReport, analyze_module,
+                                            estimate_cost,
+                                            estimate_lowered)
 from paddle_tpu.analysis.findings import (RULES, SEVERITIES, Finding,
                                           Report, Suppressions)
+from paddle_tpu.analysis.hlo_lint import (check_bucket_coverage,
+                                          embedding_bucket_coverage,
+                                          lint_cost_report,
+                                          serving_bucket_coverage)
 from paddle_tpu.analysis.jaxpr_lint import analyze_jaxpr
 
 __all__ = [
-    "LINT_MODES", "LintError", "RULES", "SEVERITIES", "Finding", "Report",
-    "Suppressions", "abstractify", "analyze_jaxpr", "enforce",
-    "lint_callable", "lint_fn", "lint_source", "lint_train_step",
+    "CostReport", "LINT_MODES", "LintError", "RULES", "SEVERITIES",
+    "Finding", "Report", "Suppressions", "abstractify", "analyze_jaxpr",
+    "analyze_module", "check_bucket_coverage", "embedding_bucket_coverage",
+    "enforce", "estimate_cost", "estimate_lowered", "lint_callable",
+    "lint_cost_report", "lint_fn", "lint_source", "lint_train_step",
+    "serving_bucket_coverage",
 ]

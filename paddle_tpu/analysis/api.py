@@ -76,6 +76,11 @@ def lint_fn(fn, *args,
             donation_min_bytes: int = 1 << 16,
             replicated_min_bytes: int = 1 << 20,
             registry: bool = True,
+            cost: bool = False,
+            hbm_budget_bytes: Optional[int] = None,
+            flops_budget: Optional[int] = None,
+            collective_allowlist=None,
+            mesh_axes=None,
             **kwargs) -> Report:
     """Statically lint ``fn(*args, **kwargs)``; returns a :class:`Report`.
 
@@ -90,7 +95,20 @@ def lint_fn(fn, *args,
     ``fn`` is an adapter closure around the real user step). Findings
     are counted into the observability registry unless
     ``registry=False``.
+
+    ``cost=True`` (implied by any of the cost options) additionally
+    lowers the function to StableHLO, attaches the static
+    :class:`~paddle_tpu.analysis.cost_model.CostReport` as
+    ``report.cost``, and runs the HLO-tier rules:
+    ``collective_allowlist`` (a sequence, possibly empty) gates
+    ``unexpected-collective``, ``hbm_budget_bytes``/``flops_budget``
+    gate the budget rules, resharding chains always report, and
+    ``mesh_axes`` (``{axis: size}``) attributes collective bytes to
+    mesh axes. Lowering only — still nothing compiles or executes.
     """
+    if hbm_budget_bytes is not None or flops_budget is not None \
+            or collective_allowlist is not None or mesh_axes is not None:
+        cost = True
     args = tuple(abstractify(a) for a in args)
     kwargs = {k: abstractify(v) for k, v in kwargs.items()}
     name = name or getattr(fn, "__name__", None) or type(fn).__name__
@@ -116,6 +134,20 @@ def lint_fn(fn, *args,
         state_tree=state_tree, replicated_min_bytes=replicated_min_bytes))
     if ast:
         report.extend(ast_lint.lint_callable(ast_fn or fn))
+    if cost:
+        from paddle_tpu.analysis import cost_model, hlo_lint
+        if hasattr(fn, "lower"):
+            lowered = fn.lower(*args, **kwargs)
+        elif donate_argnums is not None:
+            lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(
+                *args, **kwargs)
+        else:
+            lowered = jax.jit(fn).lower(*args, **kwargs)
+        report.cost = cost_model.estimate_lowered(
+            lowered, name=name, donated=donated, mesh_axes=mesh_axes)
+        report.extend(hlo_lint.lint_cost_report(
+            report.cost, collective_allowlist=collective_allowlist,
+            hbm_budget_bytes=hbm_budget_bytes, flops_budget=flops_budget))
     if registry:
         report.count_into_registry()
     return report

@@ -56,6 +56,31 @@ RULES = {
         "error",
         "Python if/while on a tracer value inside jit-reachable code: "
         "trace-time crash (ConcretizationTypeError) or silent retrace"),
+    "unexpected-collective": (
+        "error",
+        "a collective op (all-reduce/all-gather/...) in the lowered HLO "
+        "outside the declared allowlist: an implicit cross-device sync "
+        "on every step (single-device serving steps must have zero)"),
+    "resharding-churn": (
+        "warning",
+        "adjacent sharding annotations disagree on a large value's "
+        "layout: the compiler inserts an implicit transpose/all-to-all "
+        "between them on every step"),
+    "peak-hbm-budget": (
+        "error",
+        "the lowered program's static peak-HBM estimate exceeds the "
+        "preset's declared budget: the step may OOM (or silently evict) "
+        "on hardware the budget was sized for"),
+    "bucket-coverage": (
+        "error",
+        "a statically reachable pow2 bucket signature is missing from "
+        "warmup's precompile plan: the first request hitting it "
+        "recompiles mid-serving (breaks the zero-recompile invariant)"),
+    "cost-regression": (
+        "error",
+        "a static cost metric (flops / peak-HBM / collective bytes) "
+        "regressed beyond tolerance vs the committed baseline "
+        "(tools/cost_budgets.json)"),
 }
 
 
@@ -98,6 +123,7 @@ class Suppressions:
 
     def __init__(self, entries: Sequence[Tuple[str, str]] = ()):
         self.entries = list(entries)
+        self.used: set = set()      # entry indices that matched a finding
 
     @classmethod
     def load(cls, path: str) -> "Suppressions":
@@ -115,10 +141,18 @@ class Suppressions:
 
     def matches(self, context: str, finding: Finding) -> bool:
         hay = f"{context} {finding.location} {finding.message}"
-        for rule, pat in self.entries:
+        for i, (rule, pat) in enumerate(self.entries):
             if rule == finding.rule and (pat == "*" or pat in hay):
+                self.used.add(i)
                 return True
         return False
+
+    def stale(self) -> List[Tuple[str, str]]:
+        """Entries that matched nothing since construction. Run the full
+        lint surface first (the CLI checks this only after the complete
+        framework preset): a suppression that no longer fires is dead
+        weight that would silently re-accept a future regression."""
+        return [e for i, e in enumerate(self.entries) if i not in self.used]
 
 
 class Report:
@@ -131,6 +165,9 @@ class Report:
         self.findings: List[Finding] = []
         self.suppressed: List[Finding] = []
         self._suppressions = suppressions
+        #: attached by ``lint_fn(cost=True)``: the static
+        #: :class:`~paddle_tpu.analysis.cost_model.CostReport`
+        self.cost = None
         for f in findings:
             self.add(f)
 
@@ -177,6 +214,8 @@ class Report:
         order = {s: i for i, s in enumerate(reversed(SEVERITIES))}
         for f in sorted(self.findings, key=lambda f: order[f.severity]):
             lines.append(f.render())
+        if self.cost is not None:
+            lines.append("  " + self.cost.render_text().splitlines()[0])
         return "\n".join(lines)
 
     def render_json(self) -> str:
@@ -184,6 +223,8 @@ class Report:
             "name": self.name,
             "findings": [f.as_dict() for f in self.findings],
             "suppressed": [f.as_dict() for f in self.suppressed],
+            **({"cost": self.cost.as_dict()}
+               if self.cost is not None else {}),
         }, indent=1)
 
     # -- observability ------------------------------------------------------

@@ -1,7 +1,9 @@
 """Jaxpr analyzer: static hazard detection over a traced step function.
 
 Walks a closed jaxpr (recursing through pjit / scan / while / cond /
-custom-derivative sub-jaxprs) and emits findings for the TPU failure
+remat (``jax.checkpoint``) / custom-derivative sub-jaxprs — remat
+bodies are stored as OPEN jaxprs and need their own unwrap) and emits
+findings for the TPU failure
 modes that are statically visible before a single step runs:
 
 - **host-callback / debug-callback** — ``pure_callback`` / ``io_callback``
@@ -93,10 +95,19 @@ def _where(prefix: str, i: int, eqn) -> str:
     return f"{loc} ({src})" if src else loc
 
 
-def _sub_closed(params: dict, *keys):
+def _sub_open(params: dict, *keys):
+    """The inner (open) jaxpr under any of ``keys`` — accepts both
+    ClosedJaxpr params (pjit's ``jaxpr``) and bare open Jaxprs
+    (``remat2``/checkpoint store the body UNclosed, which the previous
+    ClosedJaxpr-only probe silently skipped: every rule was blind
+    inside ``jax.checkpoint`` scopes)."""
     for k in keys:
         v = params.get(k)
-        if v is not None and hasattr(v, "jaxpr"):
+        if v is None:
+            continue
+        if hasattr(v, "jaxpr"):        # ClosedJaxpr
+            return v.jaxpr
+        if hasattr(v, "eqns"):         # open core.Jaxpr
             return v
     return None
 
@@ -223,13 +234,12 @@ def analyze_jaxpr(
         params = eqn.params
         tag = f"{prefix}eqn[{i}]:{prim}/"
         if prim == "pjit" or prim in ("closed_call", "core_call", "call",
-                                      "remat", "checkpoint",
+                                      "remat", "remat2", "checkpoint",
                                       "custom_jvp_call", "custom_vjp_call",
                                       "custom_vjp_call_jaxpr"):
-            sub = _sub_closed(params, "jaxpr", "call_jaxpr", "fun_jaxpr")
-            if sub is None:
+            inner = _sub_open(params, "jaxpr", "call_jaxpr", "fun_jaxpr")
+            if inner is None:
                 return
-            inner = sub.jaxpr
             sub_env = dict(zip(inner.invars,
                                (origin(v) for v in eqn.invars)))
             sub_env = {k: v for k, v in sub_env.items() if v is not None}

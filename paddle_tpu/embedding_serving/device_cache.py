@@ -98,6 +98,7 @@ class DeviceEmbeddingCache:
         self._slot_freq = np.zeros((self.capacity + 1,), np.int64)
         self._tick = 0
         self._version: Dict[int, int] = {}
+        self.warmed_buckets: set = set()      # filled by warmup()
 
         self._gather_fn = jax.jit(
             lambda table, slots: jnp.take(table, slots, axis=0))
@@ -310,28 +311,66 @@ class DeviceEmbeddingCache:
 
     # -- lifecycle --------------------------------------------------------
 
+    def warmup_plan(self, max_uniq: int):
+        """The ``("gather", width)`` / ``("install", width)`` bucket
+        signatures :meth:`warmup` precompiles for batches of up to
+        ``max_uniq`` unique ids, in compile order — the warmup-side half
+        of the bucket-coverage proof (:func:`~paddle_tpu.analysis.
+        hlo_lint.embedding_bucket_coverage`)."""
+        cap = max(self.capacity, int(max_uniq))
+        plan = []
+        for kind, minimum in (("gather", self.min_gather_bucket),
+                              ("install", self.min_install_bucket)):
+            b = max(minimum, 1)
+            top = _pow2_bucket(int(max_uniq), minimum, cap)
+            while True:
+                plan.append((kind, b))
+                if b >= top:
+                    break
+                b *= 2
+        return plan
+
+    def reachable_buckets(self, max_uniq: int):
+        """Every gather/install width the serve path can request for
+        batches of up to ``max_uniq`` unique ids, enumerated by probing
+        the STEP-side ``_pow2_bucket`` calls (``gather``/``install``
+        bucket misses and uniq sizes 1..max_uniq) at every pow2
+        boundary — the step-side half of the coverage proof."""
+        max_uniq = int(max_uniq)
+        pts = {1, max(max_uniq, 1)}
+        p = 1
+        while p < max_uniq:           # pow2 boundaries: where the
+            pts.add(p)                # bucketing step function can move
+            if p + 1 <= max_uniq:
+                pts.add(p + 1)
+            p *= 2
+        sigs = set()
+        for n in pts:
+            # serve-time calls size their cap as max(capacity, n)
+            sigs.add(("gather", _pow2_bucket(
+                n, self.min_gather_bucket, max(self.capacity, n))))
+            # installs cover 1..uniq misses: same probe points apply
+            sigs.add(("install", _pow2_bucket(
+                n, self.min_install_bucket, max(self.capacity, n))))
+        return sigs
+
     def warmup(self, max_uniq: int):
         """Precompile every gather and install bucket a batch with up to
         ``max_uniq`` unique ids can hit (all against the null slot — no
         live rows are touched), so steady-state lookups compile
-        nothing."""
+        nothing. Records the compiled set in :attr:`warmed_buckets`."""
         import jax.numpy as jnp
 
-        cap = max(self.capacity, int(max_uniq))
-        for minimum, fn, mk in (
-                (self.min_gather_bucket, self._gather_fn,
-                 lambda b: (self.table, jnp.zeros((b,), jnp.int32))),
-                (self.min_install_bucket, self._install_fn,
-                 lambda b: (self.table, jnp.zeros((b,), jnp.int32),
-                            jnp.zeros((b, self.dim), self.dtype)))):
-            b = max(minimum, 1)
-            while True:
-                out = fn(*mk(b))
-                if fn is self._install_fn:
-                    self.table = out
-                if b >= _pow2_bucket(int(max_uniq), minimum, cap):
-                    break
-                b *= 2
+        self.warmed_buckets = set()
+        for sig in self.warmup_plan(max_uniq):
+            kind, b = sig
+            if kind == "gather":
+                self._gather_fn(self.table, jnp.zeros((b,), jnp.int32))
+            else:
+                self.table = self._install_fn(
+                    self.table, jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b, self.dim), self.dtype))
+            self.warmed_buckets.add(sig)
 
     def check_invariants(self):
         """Index consistency (the property test's spine): id→slot and

@@ -142,10 +142,14 @@ class Executor:
     """
 
     def __init__(self, place=None, mesh: Optional[Mesh] = None,
-                 lint: str = "off"):
+                 lint: str = "off",
+                 lint_cost: Optional[Dict[str, Any]] = None):
         self.place = place
         self.mesh = mesh
         self.lint = lint
+        # dict of lint_fn cost options (hbm_budget_bytes,
+        # collective_allowlist, ...) adding the HLO tier to the gate
+        self.lint_cost = lint_cost
         self._cache: Dict[int, tuple] = {}
         self._linted: set = set()
 
@@ -198,9 +202,12 @@ class Executor:
         raises :class:`~paddle_tpu.analysis.LintError` on error-severity
         findings. Donation flags come from ``program.donate_state``."""
         from paddle_tpu import analysis
+        cost_kw = dict(self.lint_cost, cost=True) \
+            if self.lint_cost is not None else {}
         report = analysis.lint_train_step(
             program.fn, state, feed, name=program.name,
-            donate_argnums=(0,) if program.donate_state else ())
+            donate_argnums=(0,) if program.donate_state else (),
+            **cost_kw)
         analysis.enforce(report, self.lint)
 
     def train_from_dataset(self, program, dataset, state, *,

@@ -164,6 +164,11 @@ class ServingEngine:
         self._rejects: "OrderedDict[int, Reject]" = OrderedDict()
         self._stats: "OrderedDict[int, Dict[str, float]]" = OrderedDict()
         self._results_cap = max(64, 16 * num_slots)
+        # filled by warmup(): compiled bucket signatures + their static
+        # cost reports (the bucket-coverage proof reads warmup_plan()
+        # when warmup has not run yet)
+        self.warmed_signatures: set = set()
+        self.bucket_costs: Dict[tuple, object] = {}
 
     # -- request surface --------------------------------------------------
 
@@ -505,11 +510,14 @@ class ServingEngine:
             s *= 2
         return min(s, self.scheduler.num_slots)
 
-    def warmup(self):
-        """Compile every decode AND prefill gather-width bucket plus the
-        CoW page copy up front (all against the null page — no live
-        state is touched), so a serving process takes its compiles at
-        startup and the steady-state loop stays at ZERO recompiles."""
+    def warmup_plan(self):
+        """The signatures ``warmup()`` precompiles, in compile order:
+        ``("decode", width)``, ``("prefill", width, lanes)``, and
+        ``("copy_page",)``. Derived from the warmup-side doubling loops
+        — :func:`~paddle_tpu.analysis.hlo_lint.serving_bucket_coverage`
+        proves this plan covers :meth:`reachable_signatures`, turning
+        the runtime zero-recompile invariant into an ahead-of-time
+        proof."""
         c = self.cache.config
         s_tot = self.scheduler.num_slots
         widths, w = [], 1
@@ -523,20 +531,96 @@ class ServingEngine:
             counts.append(s)
             s *= 2
         counts.append(s_tot)
-        zeros = jnp.zeros((s_tot,), jnp.int32)
+        counts = sorted(set(counts))
+        plan = []
         for w in widths:
-            _, self.cache.pages = self.decode_step(
-                self.params, self.cache.pages,
-                jnp.zeros((s_tot, w), jnp.int32), zeros, zeros, zeros)
-            for sb in sorted(set(counts)):
+            plan.append(("decode", w))
+            for sb in counts:
+                plan.append(("prefill", w, sb))
+        plan.append(("copy_page",))
+        return plan
+
+    def reachable_signatures(self):
+        """Every bucket signature the steady-state ``step()`` loop can
+        request, enumerated from the STEP-side bucketing functions
+        (``_pow2_width`` over every possible live page count,
+        ``_pow2_count`` over every in-prefill slot count) — the other
+        half of the bucket-coverage proof."""
+        c = self.cache.config
+        widths = {self._pow2_width(n)
+                  for n in range(1, c.max_pages_per_slot + 1)}
+        counts = {self._pow2_count(n)
+                  for n in range(1, self.scheduler.num_slots + 1)}
+        sigs = {("decode", w) for w in widths}
+        sigs |= {("prefill", w, sb) for w in widths for sb in counts}
+        sigs.add(("copy_page",))
+        return sigs
+
+    def warmup(self, cost_gauges: bool = True):
+        """Compile every decode AND prefill gather-width bucket plus the
+        CoW page copy up front (all against the null page — no live
+        state is touched), so a serving process takes its compiles at
+        startup and the steady-state loop stays at ZERO recompiles.
+        Records the compiled set in :attr:`warmed_signatures`.
+
+        ``cost_gauges`` additionally lowers each bucket through the
+        static cost model (tracing only — cheap next to the compile the
+        bucket already pays) and publishes per-bucket flops / peak-HBM
+        into ``serving_bucket_cost_flops`` /
+        ``serving_bucket_cost_peak_hbm_bytes`` gauges (labels: phase,
+        width, lanes), with the full reports kept in
+        :attr:`bucket_costs` for budget audits."""
+        s_tot = self.scheduler.num_slots
+        zeros = jnp.zeros((s_tot,), jnp.int32)
+        self.warmed_signatures = set()
+        self.bucket_costs = {}
+        for sig in self.warmup_plan():
+            if sig[0] == "decode":
+                w = sig[1]
+                args = (self.params, self.cache.pages,
+                        jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
+                        zeros)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.decode_step, args)
+                _, self.cache.pages = self.decode_step(*args)
+            elif sig[0] == "prefill":
+                w, sb = sig[1], sig[2]
                 zb = jnp.zeros((sb,), jnp.int32)
-                _, self.cache.pages = self.prefill_step(
-                    self.params, self.cache.pages,
-                    jnp.zeros((sb, w), jnp.int32), zb,
-                    jnp.zeros((sb, self.prefill_chunk), jnp.int32), zb)
-        self.cache.pages = self.copy_page_step(
-            self.cache.pages, jnp.asarray(0, jnp.int32),
-            jnp.asarray(0, jnp.int32))
+                args = (self.params, self.cache.pages,
+                        jnp.zeros((sb, w), jnp.int32), zb,
+                        jnp.zeros((sb, self.prefill_chunk), jnp.int32),
+                        zb)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.prefill_step, args)
+                _, self.cache.pages = self.prefill_step(*args)
+            else:
+                self.cache.pages = self.copy_page_step(
+                    self.cache.pages, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            self.warmed_signatures.add(sig)
+
+    def _bucket_cost_gauges(self, sig, step_fn, args):
+        """Static cost of one warmup bucket -> observability gauges
+        (lower-only; donation must not consume the live cache pages, so
+        the lowering runs on abstracted args)."""
+        from paddle_tpu.analysis import cost_model
+
+        phase, width = sig[0], sig[1]
+        lanes = sig[2] if len(sig) > 2 else self.scheduler.num_slots
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+        cost = cost_model.estimate_cost(
+            step_fn, *abstract, name=f"{phase}_w{width}")
+        self.bucket_costs[sig] = cost
+        labels = dict(phase=phase, width=str(width), lanes=str(lanes))
+        self._reg.gauge(
+            "serving_bucket_cost_flops",
+            "static flops per compiled bucket (cost model)").set(
+                cost.total_flops, **labels)
+        self._reg.gauge(
+            "serving_bucket_cost_peak_hbm_bytes",
+            "static peak-HBM estimate per compiled bucket").set(
+                cost.peak_hbm_bytes, **labels)
 
     # -- jitted step bodies ----------------------------------------------
 

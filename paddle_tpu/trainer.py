@@ -103,7 +103,9 @@ class Trainer:
             epochs: int = 1,
             steps_per_epoch: Optional[int] = None,
             make_iter: Optional[Callable] = None,
-            lint: str = "off") -> Dict[str, float]:
+            lint: str = "off",
+            lint_cost: Optional[Dict[str, Any]] = None
+            ) -> Dict[str, float]:
         """Train over batches. ``data_iter`` is an iterable of feed dicts
         (re-created per epoch via ``make_iter`` when given — pass the
         dataset's ``.batches`` factory for multi-epoch runs).
@@ -112,7 +114,15 @@ class Trainer:
         the first batch before any step runs (``paddle_tpu.analysis``:
         host syncs, f64 promotions, missed donation, PRNG key reuse,
         tracer branches); ``'warn'`` logs findings, ``'error'`` raises
-        :class:`~paddle_tpu.analysis.LintError` on error-severity ones."""
+        :class:`~paddle_tpu.analysis.LintError` on error-severity ones.
+
+        ``lint_cost`` adds the HLO cost tier to the same gate: a dict of
+        :func:`~paddle_tpu.analysis.lint_fn` cost options, e.g.
+        ``{"hbm_budget_bytes": 2 << 30, "collective_allowlist":
+        ["all_reduce"]}`` — the train step is then lowered to StableHLO
+        and checked for unexpected collectives, resharding churn, and
+        the peak-HBM/flops budgets (pass ``{}`` for the cost report
+        alone)."""
         if epochs > 1 and make_iter is None and not hasattr(
                 data_iter, "__len__"):
             raise ValueError(
@@ -132,7 +142,7 @@ class Trainer:
         try:
             last_metrics = self._fit_epochs(
                 epochs, data_iter, make_iter, steps_per_epoch, tel, gstep,
-                lint=lint)
+                lint=lint, lint_cost=lint_cost)
         finally:
             if tel is not None:
                 tel.close(summary={"metrics": last_metrics})
@@ -148,7 +158,7 @@ class Trainer:
         return last_metrics
 
     def _fit_epochs(self, epochs, data_iter, make_iter, steps_per_epoch,
-                    tel, gstep, lint="off"):
+                    tel, gstep, lint="off", lint_cost=None):
         last_metrics: Dict[str, float] = {}
         metrics: Dict[str, Any] = {}
         for epoch in range(epochs):
@@ -167,7 +177,7 @@ class Trainer:
                     # compiles or executes), against the real first batch.
                     # data_wait was captured above so trace time is not
                     # booked as an input stall.
-                    self._lint(batch, lint)
+                    self._lint(batch, lint, lint_cost)
                 if tel is not None:
                     tel.data_wait(data_wait_s)
                 t_step = time.perf_counter()
@@ -251,12 +261,15 @@ class Trainer:
         return outs
 
 
-    def _lint(self, batch: Dict[str, Any], mode: str):
+    def _lint(self, batch: Dict[str, Any], mode: str, lint_cost=None):
         """Static analysis of the train step against one batch's avals
-        (``paddle_tpu.analysis``); 'warn' logs, 'error' raises."""
+        (``paddle_tpu.analysis``); 'warn' logs, 'error' raises.
+        ``lint_cost`` (a dict of cost options) adds the HLO tier."""
         from paddle_tpu import analysis
+        cost_kw = dict(lint_cost, cost=True) if lint_cost is not None \
+            else {}
         report = analysis.lint_train_step(self.train_step, self.state,
-                                          batch)
+                                          batch, **cost_kw)
         analysis.enforce(report, mode, log_fn=self.log_fn)
 
     def _emergency_snapshot(self):

@@ -534,3 +534,352 @@ class TestNanChecks:
             assert jax.config.jax_debug_nans is True
         finally:
             jax.config.update("jax_debug_nans", prev)
+
+
+# ---------------------------------------------------------------------------
+# HLO tier (ISSUE 9): cost model, HLO rules, bucket coverage, cost CLI
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_matmul_flops_exact(self):
+        from paddle_tpu.analysis import cost_model
+        r = cost_model.estimate_cost(
+            lambda x, w: x @ w,
+            jax.ShapeDtypeStruct((8, 16), jnp.float32),
+            jax.ShapeDtypeStruct((16, 32), jnp.float32))
+        assert r.per_op["dot_general"].flops == 2 * 8 * 16 * 32
+        assert r.arg_bytes == (8 * 16 + 16 * 32) * 4
+        assert r.out_bytes == 8 * 32 * 4
+        assert r.collective_bytes == 0 and not r.collectives
+
+    def test_donation_lowers_peak_hbm(self):
+        """Donated state aliases into the output: old+new copies must
+        not both count (the static face of donate_argnums)."""
+        from paddle_tpu.analysis import cost_model
+        def step(s, x):
+            return s + x.sum()
+        a = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+        b = jax.ShapeDtypeStruct((8,), jnp.float32)
+        undonated = cost_model.estimate_cost(step, a, b)
+        donated = cost_model.estimate_cost(step, a, b, donate_argnums=0)
+        assert donated.peak_hbm_bytes < undonated.peak_hbm_bytes
+        assert donated.donated_bytes == 512 * 512 * 4
+
+    def test_report_roundtrip_and_summary(self):
+        from paddle_tpu.analysis import cost_model
+        r = cost_model.estimate_cost(
+            lambda x: jnp.tanh(x).sum(),
+            jax.ShapeDtypeStruct((64, 64), jnp.float32))
+        d = r.as_dict()
+        assert set(r.summary()) == {"flops", "peak_hbm_bytes",
+                                    "traffic_bytes", "collective_bytes"}
+        assert d["per_op"]["tanh"]["count"] == 1
+        assert "tanh" in r.render_text() or "flops" in r.render_text()
+
+    def test_lint_fn_attaches_cost(self):
+        rep = lint_fn(lambda x: x * 2.0, jnp.ones((16,)), cost=True,
+                      registry=False)
+        assert rep.cost is not None
+        assert rep.cost.summary()["flops"] > 0
+        assert "cost" in rep.render_json()
+
+
+class TestUnexpectedCollectiveRule:
+    def _psum_fn(self, mesh):
+        from paddle_tpu.core import compat
+        return compat.shard_map(
+            lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+            in_specs=P("dp", "tp"), out_specs=P("dp", None))
+
+    def test_fires_on_undeclared_psum(self, mesh_dp2_tp4):
+        rep = lint_fn(self._psum_fn(mesh_dp2_tp4),
+                      jax.ShapeDtypeStruct((8, 16), jnp.float32),
+                      collective_allowlist=[], registry=False,
+                      mesh_axes={"dp": 2, "tp": 4})
+        assert "unexpected-collective" in _rules(rep)
+        assert rep.errors
+        [c] = rep.cost.collectives
+        assert c.kind == "all_reduce" and c.axis == "tp"
+
+    def test_silent_when_allowlisted(self, mesh_dp2_tp4):
+        rep = lint_fn(self._psum_fn(mesh_dp2_tp4),
+                      jax.ShapeDtypeStruct((8, 16), jnp.float32),
+                      collective_allowlist=["all_reduce"],
+                      registry=False)
+        assert "unexpected-collective" not in _rules(rep)
+
+    def test_silent_on_collective_free_twin(self):
+        rep = lint_fn(lambda x: (x * 2).sum(), jnp.ones((8, 16)),
+                      collective_allowlist=[], registry=False)
+        assert "unexpected-collective" not in _rules(rep)
+        assert rep.cost.collective_bytes == 0
+
+
+class TestReshardingChurnRule:
+    def test_fires_on_disagreeing_constraints(self, mesh_dp2_tp4):
+        s1 = NamedSharding(mesh_dp2_tp4, P("dp", None))
+        s2 = NamedSharding(mesh_dp2_tp4, P(None, "dp"))
+
+        def churn(x):
+            x = jax.lax.with_sharding_constraint(x, s1)
+            x = x * 2.0
+            return jax.lax.with_sharding_constraint(x, s2)
+
+        rep = lint_fn(churn, jax.ShapeDtypeStruct((512, 512), jnp.float32),
+                      cost=True, registry=False)
+        assert "resharding-churn" in _rules(rep)
+        assert rep.cost.resharding[0].bytes == 512 * 512 * 4
+
+    def test_silent_on_agreeing_constraints(self, mesh_dp2_tp4):
+        s1 = NamedSharding(mesh_dp2_tp4, P("dp", None))
+
+        def steady(x):
+            x = jax.lax.with_sharding_constraint(x, s1)
+            x = x * 2.0
+            return jax.lax.with_sharding_constraint(x, s1)
+
+        rep = lint_fn(steady, jax.ShapeDtypeStruct((512, 512), jnp.float32),
+                      cost=True, registry=False)
+        assert "resharding-churn" not in _rules(rep)
+
+    def test_small_values_ignored(self, mesh_dp2_tp4):
+        s1 = NamedSharding(mesh_dp2_tp4, P("dp"))
+        s2 = NamedSharding(mesh_dp2_tp4, P(None))
+
+        def churn(x):
+            x = jax.lax.with_sharding_constraint(x, s1)
+            return jax.lax.with_sharding_constraint(x * 2.0, s2)
+
+        rep = lint_fn(churn, jax.ShapeDtypeStruct((8,), jnp.float32),
+                      cost=True, registry=False)
+        assert "resharding-churn" not in _rules(rep)
+
+
+class TestPeakHbmBudgetRule:
+    def test_fires_over_budget(self):
+        rep = lint_fn(lambda x: x * 2.0, jnp.ones((256, 256)),
+                      hbm_budget_bytes=1024, registry=False)
+        assert "peak-hbm-budget" in _rules(rep)
+        assert rep.errors
+
+    def test_silent_under_budget(self):
+        rep = lint_fn(lambda x: x * 2.0, jnp.ones((256, 256)),
+                      hbm_budget_bytes=1 << 30, registry=False)
+        assert "peak-hbm-budget" not in _rules(rep)
+
+    def test_flops_budget_fires_cost_regression(self):
+        rep = lint_fn(lambda x, w: x @ w,
+                      jnp.ones((64, 64)), jnp.ones((64, 64)),
+                      flops_budget=10, registry=False)
+        assert "cost-regression" in _rules(rep)
+
+
+class TestBucketCoverage:
+    def _engine(self, **kw):
+        from paddle_tpu import serving
+        from paddle_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny())
+        params = model.init(jax.random.PRNGKey(0))
+        kw.setdefault("num_slots", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_tokens_per_slot", 64)
+        return serving.ServingEngine(model, params, attn_impl="lax", **kw)
+
+    def test_serving_plan_covers_reachable(self):
+        eng = self._engine()
+        assert analysis.serving_bucket_coverage(eng) == []
+        # the two derivations agree exactly (plan has no dead buckets)
+        assert set(eng.warmup_plan()) == set(eng.reachable_signatures())
+
+    def test_serving_nonpow2_config_covered(self):
+        eng = self._engine(num_slots=6, max_tokens_per_slot=72)
+        assert analysis.serving_bucket_coverage(eng) == []
+
+    def test_skipped_warmup_bucket_fires(self):
+        """ISSUE acceptance: deliberately skip one warmup bucket and the
+        rule must prove the gap."""
+        eng = self._engine()
+        plan = set(eng.warmup_plan())
+        skipped = sorted(plan, key=str)[0]
+        findings = analysis.serving_bucket_coverage(
+            eng, warmed=plan - {skipped})
+        assert [f.rule for f in findings] == ["bucket-coverage"]
+        assert str(skipped) in findings[0].message \
+            or str(skipped) in findings[0].location
+
+    def test_embedding_plan_covers_reachable(self):
+        from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+        for capacity, max_uniq in ((64, 48), (50, 50), (64, 64)):
+            cache = DeviceEmbeddingCache(capacity, 9, min_gather_bucket=8)
+            assert analysis.embedding_bucket_coverage(
+                cache, max_uniq) == [], (capacity, max_uniq)
+
+    def test_embedding_skipped_bucket_fires(self):
+        from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+        cache = DeviceEmbeddingCache(64, 9, min_gather_bucket=8)
+        plan = set(cache.warmup_plan(48))
+        skipped = sorted(plan, key=str)[-1]
+        findings = analysis.embedding_bucket_coverage(
+            cache, 48, warmed=plan - {skipped})
+        assert [f.rule for f in findings] == ["bucket-coverage"]
+
+    def test_dispatch_helper(self):
+        from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+        eng = self._engine()
+        assert analysis.check_bucket_coverage(eng) == []
+        cache = DeviceEmbeddingCache(64, 9, min_gather_bucket=8)
+        assert analysis.check_bucket_coverage(cache, max_uniq=48) == []
+        with pytest.raises(ValueError):
+            analysis.check_bucket_coverage(cache)
+
+    def test_warmup_records_signatures_and_cost_gauges(self):
+        reg = observability.MetricsRegistry()
+        eng = self._engine(num_slots=2, page_size=8,
+                           max_tokens_per_slot=16, registry=reg)
+        eng.warmup()
+        assert eng.warmed_signatures == set(eng.warmup_plan())
+        # per-bucket static cost gauges published during warmup
+        g = reg.gauge("serving_bucket_cost_flops")
+        assert g.value(phase="decode", width="1", lanes="2") > 0
+        assert ("decode", 1) in eng.bucket_costs
+        assert eng.bucket_costs[("decode", 1)].summary()["flops"] > 0
+
+
+class TestRematRecursion:
+    """Satellite: rules must see through jax.checkpoint/remat scopes
+    (the remat body is stored as an OPEN jaxpr the recursion previously
+    skipped)."""
+
+    def test_key_reuse_inside_remat_fires(self):
+        def bad(x, key):
+            def inner(x):
+                a = jax.random.normal(key, x.shape)
+                b = jax.random.uniform(key, x.shape)
+                return jnp.sum(x * a * b)
+            return jax.checkpoint(inner)(x)
+        rep = lint_fn(bad, jnp.ones((4,)), jax.random.PRNGKey(0),
+                      registry=False)
+        assert "prng-key-reuse" in _rules(rep)
+
+    def test_split_inside_remat_is_silent(self):
+        def good(x, key):
+            def inner(x):
+                k1, k2 = jax.random.split(key)
+                return jnp.sum(x * jax.random.normal(k1, x.shape)
+                               * jax.random.uniform(k2, x.shape))
+            return jax.checkpoint(inner)(x)
+        rep = lint_fn(good, jnp.ones((4,)), jax.random.PRNGKey(0),
+                      registry=False)
+        assert "prng-key-reuse" not in _rules(rep)
+
+    def test_host_callback_inside_remat_fires(self):
+        def cb(x):
+            def inner(x):
+                return jax.pure_callback(
+                    lambda a: a, jax.ShapeDtypeStruct((4,), jnp.float32),
+                    x).sum()
+            return jax.checkpoint(inner)(x)
+        rep = lint_fn(cb, jnp.ones((4,)), registry=False)
+        assert "host-callback" in _rules(rep)
+
+
+class TestStaleSuppressions:
+    def test_used_entry_not_stale(self):
+        sup = Suppressions([("f64-promotion", "*")])
+        rep = Report("fn", suppressions=sup)
+        rep.add(Finding("f64-promotion", "warning", "m"))
+        assert rep.suppressed and sup.stale() == []
+
+    def test_unused_entry_is_stale(self):
+        sup = Suppressions([("f64-promotion", "*"),
+                            ("prng-key-reuse", "never_matches")])
+        rep = Report("fn", suppressions=sup)
+        rep.add(Finding("f64-promotion", "warning", "m"))
+        assert sup.stale() == [("prng-key-reuse", "never_matches")]
+
+
+class TestCostCli:
+    def _cli(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "graph_lint", os.path.join(os.path.dirname(__file__),
+                                       "..", "tools", "graph_lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_cost_diff_flags_regression(self):
+        mod = self._cli()
+        budgets = {"tolerance": 0.10, "surfaces": {
+            "s": {"flops": 100, "peak_hbm_bytes": 1000,
+                  "collective_bytes": 0}}}
+        ok = {"s": {"flops": 105, "peak_hbm_bytes": 1000,
+                    "collective_bytes": 0}}
+        bad = {"s": {"flops": 150, "peak_hbm_bytes": 1000,
+                     "collective_bytes": 0}}
+        sink = []
+        assert mod.cost_diff(ok, budgets, out=sink.append) == 0
+        assert mod.cost_diff(bad, budgets, out=sink.append) == 1
+        assert any("REGRESSION" in s for s in sink)
+
+    def test_cost_diff_collectives_from_zero_fail(self):
+        mod = self._cli()
+        budgets = {"tolerance": 0.10, "surfaces": {
+            "s": {"flops": 100, "peak_hbm_bytes": 1000,
+                  "collective_bytes": 0}}}
+        grew = {"s": {"flops": 100, "peak_hbm_bytes": 1000,
+                      "collective_bytes": 4096}}
+        assert mod.cost_diff(grew, budgets, out=lambda *_: None) == 1
+
+    def test_cost_diff_missing_baseline_fails(self):
+        mod = self._cli()
+        budgets = {"tolerance": 0.10, "surfaces": {}}
+        assert mod.cost_diff(
+            {"new": {"flops": 1, "peak_hbm_bytes": 1,
+                     "collective_bytes": 0}},
+            budgets, out=lambda *_: None) == 1
+
+    def test_bucket_coverage_report_green(self):
+        rep = self._cli().bucket_coverage_report(None)
+        assert rep.ok("error"), rep.render_text()
+
+    @pytest.mark.slow
+    def test_cost_preset_green(self):
+        """The CI cost stage (run_ci.sh): --cost --cost-diff must pass
+        against the committed tools/cost_budgets.json."""
+        assert self._cli().main(
+            ["--preset", "framework", "--cost", "--cost-diff"]) == 0
+
+    @pytest.mark.slow
+    def test_injected_regression_fails_cost_diff(self, tmp_path):
+        """ISSUE acceptance: --cost-diff demonstrably fails on an
+        injected >10% budget regression."""
+        import json
+        mod = self._cli()
+        with open(mod.DEFAULT_BUDGETS) as f:
+            budgets = json.load(f)
+        # shrink one committed baseline so the measured value reads as
+        # a +50% regression
+        budgets["surfaces"]["serving_decode"]["flops"] = int(
+            budgets["surfaces"]["serving_decode"]["flops"] / 1.5)
+        doctored = tmp_path / "budgets.json"
+        doctored.write_text(json.dumps(budgets))
+        assert mod.main(["--preset", "framework", "--cost-diff",
+                         "--budgets", str(doctored)]) == 1
+
+
+class TestTrainerCostGate:
+    def test_lint_cost_budget_enforced(self):
+        trainer, batches = _mnist_trainer()
+        with pytest.raises(LintError) as e:
+            trainer.fit(batches, lint="error",
+                        lint_cost={"hbm_budget_bytes": 1024})
+        assert "peak-hbm-budget" in str(e.value)
+
+    def test_lint_cost_clean_trains(self):
+        trainer, batches = _mnist_trainer()
+        metrics = trainer.fit(batches, lint="error",
+                              lint_cost={"hbm_budget_bytes": 1 << 30,
+                                         "collective_allowlist": []})
+        assert "loss" in metrics

@@ -3,20 +3,39 @@
 CI self-lint (``tools/run_ci.sh``)::
 
     python tools/graph_lint.py --preset framework
+    python tools/graph_lint.py --preset framework --cost --cost-diff
 
 lints representative zoo step functions — LeNet train step, ResNet-18
-train step, GPT (tiny) cached decode step, and the VGG-style
-ImgConvGroup dropout forward — and exits 1 on any unsuppressed
+train step, GPT (tiny) cached decode step, the VGG-style ImgConvGroup
+dropout forward, the serving decode/prefill steps, and the embedding-
+serving install/lookup steps — and exits 1 on any unsuppressed
 error-severity finding. ``tools/graph_lint_suppressions.txt`` is the
-committed allow-list for known-accepted warnings.
+committed allow-list for known-accepted warnings; entries that no
+longer match any finding are themselves an error (stale suppressions
+rot silently and would re-accept a future regression).
 
-Everything here is abstract tracing: no weights are trained, nothing is
-compiled or executed, so the whole preset runs in seconds on CPU.
+``--cost`` adds the HLO tier: every surface is lowered to StableHLO and
+cost-analyzed (``analysis.cost_model``), then checked for unexpected
+collectives (single-device serving steps must have ZERO), resharding
+churn, and the peak-HBM/flops budgets committed in
+``tools/cost_budgets.json``; plus the bucket-coverage proof that the
+serving engines' ``warmup()`` plans precompile every statically
+reachable pow2 signature. ``--cost-diff`` compares the measured static
+flops / peak-HBM / collective-bytes against the committed baselines and
+fails when any regresses beyond the manifest's tolerance — a perf-
+regression gate that needs no hardware. ``--update-budgets`` rewrites
+the manifest from the current measurements (commit it with the PR that
+legitimately moved the numbers).
+
+Everything here is abstract tracing and lowering: no weights are
+trained, nothing is compiled or executed, so the whole preset runs in
+seconds on CPU.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -25,17 +44,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# pin the RNG lowering: partitionable threefry changes the op mix of
+# dropout surfaces, and the committed cost budgets must be a
+# deterministic function of the module regardless of caller env (the
+# test suite runs with this flag on; it is also jax's forward default)
+jax.config.update("jax_threefry_partitionable", True)
 
 import jax.numpy as jnp  # noqa: E402
 
 from paddle_tpu import analysis  # noqa: E402
+from paddle_tpu.analysis import hlo_lint  # noqa: E402
 
 DEFAULT_SUPPRESSIONS = os.path.join(os.path.dirname(__file__),
                                     "graph_lint_suppressions.txt")
+DEFAULT_BUDGETS = os.path.join(os.path.dirname(__file__),
+                               "cost_budgets.json")
+
+#: metrics --cost-diff gates against the committed baseline
+DIFF_METRICS = ("flops", "peak_hbm_bytes", "collective_bytes")
 
 
 def _train_step_report(model, loss_fn, sample_batch, *, name,
-                       suppressions, lr=1e-3):
+                       suppressions, lr=1e-3, cost=False):
     from paddle_tpu import optimizer as opt
     from paddle_tpu.train import build_train_step, make_train_state
 
@@ -43,10 +73,10 @@ def _train_step_report(model, loss_fn, sample_batch, *, name,
     state = make_train_state(model, optim, jax.random.PRNGKey(0))
     step = jax.jit(build_train_step(loss_fn, optim), donate_argnums=0)
     return analysis.lint_train_step(step, state, sample_batch, name=name,
-                                    suppressions=suppressions)
+                                    suppressions=suppressions, cost=cost)
 
 
-def lint_lenet(suppressions):
+def lint_lenet(suppressions, cost=False):
     from paddle_tpu.models import LeNet
     from paddle_tpu.ops import nn as F
 
@@ -59,10 +89,10 @@ def lint_lenet(suppressions):
     batch = {"image": jnp.zeros((8, 28, 28, 1), jnp.float32),
              "label": jnp.zeros((8, 1), jnp.int32)}
     return _train_step_report(model, loss_fn, batch, name="lenet_train",
-                              suppressions=suppressions)
+                              suppressions=suppressions, cost=cost)
 
 
-def lint_resnet18(suppressions):
+def lint_resnet18(suppressions, cost=False):
     from paddle_tpu.models import ResNet
     from paddle_tpu.ops import nn as F
 
@@ -76,10 +106,10 @@ def lint_resnet18(suppressions):
              "label": jnp.zeros((4, 1), jnp.int32)}
     return _train_step_report(model, loss_fn, batch,
                               name="resnet18_train",
-                              suppressions=suppressions)
+                              suppressions=suppressions, cost=cost)
 
 
-def lint_gpt_decode(suppressions):
+def lint_gpt_decode(suppressions, cost=False):
     """Cached single-token decode step, jitted WITHOUT cache donation —
     the undonated-cache warning this produces is a known-accepted entry
     in the suppression file (``generate()`` donates at its own jit
@@ -99,11 +129,11 @@ def lint_gpt_decode(suppressions):
         jax.ShapeDtypeStruct((), jnp.int32),
         analysis.abstractify(cache),
         name="gpt_decode", ast_fn=model.decode_step,
-        suppressions=suppressions)
+        suppressions=suppressions, cost=cost)
     return report
 
 
-def lint_convgroup(suppressions):
+def lint_convgroup(suppressions, cost=False):
     """VGG building block with per-layer fold_in dropout keys — the PRNG
     hygiene surface (must stay key-reuse clean)."""
     from paddle_tpu.nn import ImgConvGroup
@@ -119,29 +149,47 @@ def lint_convgroup(suppressions):
         fwd, analysis.abstractify(params),
         jax.random.PRNGKey(1),
         jax.ShapeDtypeStruct((2, 16, 16, 3), jnp.float32),
-        name="vgg_convgroup", suppressions=suppressions)
+        name="vgg_convgroup", suppressions=suppressions, cost=cost)
 
 
-def lint_serving_decode(suppressions):
+_TINY_GPT = None
+
+
+def _tiny_gpt():
+    """One shared tiny GPT for every serving surface in the preset
+    (model.init compiles and runs real computation — pay it once)."""
+    global _TINY_GPT
+    if _TINY_GPT is None:
+        from paddle_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny())
+        _TINY_GPT = (model, model.init(jax.random.PRNGKey(0)))
+    return _TINY_GPT
+
+
+def _tiny_serving_engine(**kw):
+    from paddle_tpu import serving
+
+    model, params = _tiny_gpt()
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_tokens_per_slot", 64)
+    return serving.ServingEngine(model, params, attn_impl="lax", **kw)
+
+
+def lint_serving_decode(suppressions, cost=False):
     """The serving engine's continuous-batching decode step — the hot
     path of ISSUE 4. Unlike the bare ``gpt_decode`` surface above, the
     engine IS the donating surface: its jitted step donates the KV cache
     pages (single-use by construction — the engine replaces its page
     handles every call), so this must lint clean with NO undonated-
-    buffer suppression."""
+    buffer suppression. Under ``--cost`` the single-device serving
+    contract also applies: ZERO collectives in the lowered step."""
     import jax.numpy as jnp
 
-    from paddle_tpu import serving
-    from paddle_tpu.models.gpt import GPT, GPTConfig
-
-    cfg = GPTConfig.tiny()
-    model = GPT(cfg)
-    params = model.init(jax.random.PRNGKey(0))
-    eng = serving.ServingEngine(model, params, num_slots=4, page_size=8,
-                                max_tokens_per_slot=64, attn_impl="lax")
+    eng = _tiny_serving_engine()
     c = eng.cache.config
     return analysis.lint_fn(
-        eng.decode_step, analysis.abstractify(params),
+        eng.decode_step, analysis.abstractify(eng.params),
         analysis.abstractify(eng.cache.pages),
         jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
                              jnp.int32),
@@ -149,28 +197,21 @@ def lint_serving_decode(suppressions):
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         name="serving_decode", ast_fn=eng._decode_step_impl,
-        suppressions=suppressions)
+        suppressions=suppressions, cost=cost)
 
 
-def lint_serving_prefill(suppressions):
+def lint_serving_prefill(suppressions, cost=False):
     """The batched chunked-prefill step (ISSUE 6) — the other jitted
     serving surface. Same contract as decode: the engine donates the KV
     cache pages into the step (single-use by construction), and nothing
     inside may sync to the host — so it must lint clean with NO
-    undonated-buffer suppression."""
+    undonated-buffer suppression (and zero collectives under --cost)."""
     import jax.numpy as jnp
 
-    from paddle_tpu import serving
-    from paddle_tpu.models.gpt import GPT, GPTConfig
-
-    cfg = GPTConfig.tiny()
-    model = GPT(cfg)
-    params = model.init(jax.random.PRNGKey(0))
-    eng = serving.ServingEngine(model, params, num_slots=4, page_size=8,
-                                max_tokens_per_slot=64, attn_impl="lax")
+    eng = _tiny_serving_engine()
     c = eng.cache.config
     return analysis.lint_fn(
-        eng.prefill_step, analysis.abstractify(params),
+        eng.prefill_step, analysis.abstractify(eng.params),
         analysis.abstractify(eng.cache.pages),
         jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
                              jnp.int32),
@@ -178,10 +219,10 @@ def lint_serving_prefill(suppressions):
         jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         name="serving_prefill", ast_fn=eng._prefill_step_impl,
-        suppressions=suppressions)
+        suppressions=suppressions, cost=cost)
 
 
-def lint_embedding_install(suppressions):
+def lint_embedding_install(suppressions, cost=False):
     """The embedding-serving cache's update step: the device hot-row
     table is DONATED into the bucketed scatter (the engine replaces its
     table handle every install — single-use by construction), so this
@@ -193,10 +234,11 @@ def lint_embedding_install(suppressions):
         cache._install_fn, analysis.abstractify(cache.table),
         jax.ShapeDtypeStruct((8,), jnp.int32),
         jax.ShapeDtypeStruct((8, 9), jnp.float32),
-        name="embedding_cache_install", suppressions=suppressions)
+        name="embedding_cache_install", suppressions=suppressions,
+        cost=cost)
 
 
-def lint_embedding_lookup(suppressions):
+def lint_embedding_lookup(suppressions, cost=False):
     """The embedding-serving hot path: fixed-shape gather out of the
     (read-only) device table straight into the DeepFM forward. Nothing
     inside may sync to the host (no callbacks, no .item()) — misses are
@@ -219,7 +261,33 @@ def lint_embedding_lookup(suppressions):
         jax.ShapeDtypeStruct((8,), jnp.int32),
         jax.ShapeDtypeStruct((4, 4), jnp.int32),
         name="embedding_lookup_serve", ast_fn=serve,
-        suppressions=suppressions)
+        suppressions=suppressions, cost=cost)
+
+
+def bucket_coverage_report(suppressions):
+    """The ahead-of-time zero-recompile proof (``--cost`` only): the
+    serving engines' statically reachable pow2 bucket signatures must
+    all be in their ``warmup()`` precompile plans. The coverage check
+    itself is pure host math (no tracing, no compiles — engine
+    construction reuses the preset's shared tiny GPT); includes
+    deliberately non-pow2 configurations (the historical failure mode:
+    a raw capacity clamp minting a width the warmup doubling loop never
+    visits)."""
+    from paddle_tpu.embedding_serving import DeviceEmbeddingCache
+
+    report = analysis.Report("bucket_coverage", suppressions=suppressions)
+    for slots, page, cap, tag in ((4, 8, 64, "pow2"),
+                                  (6, 8, 72, "nonpow2")):
+        eng = _tiny_serving_engine(num_slots=slots, page_size=page,
+                                   max_tokens_per_slot=cap)
+        report.extend(hlo_lint.serving_bucket_coverage(
+            eng, name=f"serving_{tag}"))
+    for capacity, max_uniq, tag in ((64, 48, "pow2"), (50, 50, "nonpow2")):
+        cache = DeviceEmbeddingCache(capacity, 9, min_gather_bucket=8)
+        report.extend(hlo_lint.embedding_bucket_coverage(
+            cache, max_uniq, name=f"embedding_{tag}"))
+    report.count_into_registry()
+    return report
 
 
 PRESETS = {
@@ -228,6 +296,53 @@ PRESETS = {
                   lint_serving_prefill, lint_embedding_install,
                   lint_embedding_lookup],
 }
+
+
+def _load_budgets(path: str) -> dict:
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"tolerance": 0.10, "surfaces": {}}
+
+
+def cost_diff(measured: dict, budgets: dict, *, out=print) -> int:
+    """Compare measured static costs against the committed baselines;
+    returns 1 when any gated metric regressed beyond tolerance (or a
+    surface has no committed baseline)."""
+    tol = float(budgets.get("tolerance", 0.10))
+    surfaces = budgets.get("surfaces", {})
+    rc = 0
+    out(f"cost diff vs committed baselines (tolerance {tol:.0%}):")
+    for name in sorted(measured):
+        spec = surfaces.get(name)
+        if spec is None:
+            out(f"  FAIL {name}: no committed baseline — run "
+                "--update-budgets and commit tools/cost_budgets.json")
+            rc = 1
+            continue
+        for metric in DIFF_METRICS:
+            base = int(spec.get(metric, 0))
+            now = int(measured[name].get(metric, 0))
+            limit = base * (1.0 + tol)
+            delta = (now - base) / base if base else (1.0 if now else 0.0)
+            flag = ""
+            if now > limit:
+                flag = f"  REGRESSION (> {tol:+.0%})"
+                rc = 1
+            elif base and now < base * (1.0 - tol):
+                flag = "  (improved — refresh with --update-budgets)"
+            out(f"  {name:24s} {metric:18s} {base:>14,d} -> {now:>14,d} "
+                f"{delta:+7.1%}{flag}")
+    gone = sorted(set(surfaces) - set(measured))
+    for name in gone:
+        out(f"  FAIL {name}: committed baseline has no measured surface "
+            "(remove it from tools/cost_budgets.json)")
+        rc = 1
+    if rc:
+        out("cost diff FAILED — a static cost metric regressed beyond "
+            "tolerance (see above); if intended, regenerate the manifest "
+            "with --update-budgets and justify it in the PR")
+    return rc
 
 
 def main(argv=None) -> int:
@@ -246,6 +361,17 @@ def main(argv=None) -> int:
                     help="emit one JSON report per model instead of text")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule registry and exit")
+    ap.add_argument("--cost", action="store_true",
+                    help="add the HLO cost tier: collective/resharding/"
+                         "budget rules + the warmup bucket-coverage proof")
+    ap.add_argument("--cost-diff", action="store_true",
+                    help="fail when static flops/peak-HBM/collective "
+                         "bytes regress beyond the committed tolerance")
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS,
+                    help="budget manifest (tools/cost_budgets.json)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="rewrite the budget manifest from the current "
+                         "measurements (commit it with the PR)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -258,12 +384,78 @@ def main(argv=None) -> int:
             os.path.exists(args.suppressions):
         sup = analysis.Suppressions.load(args.suppressions)
 
+    cost_mode = args.cost or args.cost_diff or args.update_budgets
+    budgets = _load_budgets(args.budgets) if cost_mode else None
+    tol = float(budgets.get("tolerance", 0.10)) if budgets else 0.10
+    measured = {}
+
     rc = 0
     for build in PRESETS[args.preset]:
-        report = build(sup)
+        report = build(sup, cost=cost_mode)
+        if cost_mode and report.cost is not None:
+            measured[report.name] = report.cost.summary()
+            if args.cost:
+                spec = budgets["surfaces"].get(report.name, {})
+                report.extend(hlo_lint.lint_cost_report(
+                    report.cost,
+                    collective_allowlist=spec.get("collectives", []),
+                    hbm_budget_bytes=int(
+                        spec["peak_hbm_bytes"] * (1 + tol))
+                    if "peak_hbm_bytes" in spec else None,
+                    flops_budget=int(spec["flops"] * (1 + tol))
+                    if "flops" in spec else None))
         print(report.render_json() if args.json else report.render_text())
         if not report.ok(args.fail_on):
             rc = 1
+
+    if args.cost:
+        report = bucket_coverage_report(sup)
+        print(report.render_json() if args.json else report.render_text())
+        if not report.ok(args.fail_on):
+            rc = 1
+
+    if args.update_budgets:
+        manifest = {
+            "_comment": [
+                "Static cost baselines for tools/graph_lint.py "
+                "--cost/--cost-diff.",
+                "Regenerate with: python tools/graph_lint.py --preset "
+                "framework --update-budgets",
+                "and commit alongside any PR that legitimately moves "
+                "the numbers.",
+                "'collectives' is the per-surface allowlist of "
+                "permitted collective kinds",
+                "(empty = the single-device contract: zero collectives "
+                "in the lowered step).",
+            ],
+            "tolerance": tol,
+            "surfaces": {
+                name: {**vals,
+                       "collectives": budgets["surfaces"]
+                       .get(name, {}).get("collectives", [])}
+                for name, vals in sorted(measured.items())
+            },
+        }
+        with open(args.budgets, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.budgets} ({len(measured)} surfaces)")
+    elif args.cost_diff:
+        rc = max(rc, cost_diff(measured, budgets))
+
+    # stale-suppression gate: only meaningful after the FULL preset has
+    # had the chance to match every committed entry
+    if sup is not None and args.preset == "framework":
+        stale = sup.stale()
+        if stale:
+            for rule, pat in stale:
+                print(f"stale suppression: `{rule}  {pat}` matched no "
+                      "finding — delete it from "
+                      f"{args.suppressions} (dead entries would "
+                      "silently re-accept a future regression)",
+                      file=sys.stderr)
+            rc = 1
+
     if rc:
         print(f"graph lint FAILED (findings at >= {args.fail_on} "
               "severity; see above)", file=sys.stderr)

@@ -86,11 +86,19 @@ print("embedding serving dryrun metrics OK")
 '
 
 # static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
-# decode, VGG conv-group dropout) must be free of error-severity graph
-# hazards (host syncs, key reuse, tracer branches); accepted warnings
-# live in tools/graph_lint_suppressions.txt
-echo "== graph self-lint (framework preset) =="
-python tools/graph_lint.py --preset framework
+# decode, VGG conv-group dropout, serving decode/prefill, embedding
+# install/lookup) must be free of error-severity graph hazards (host
+# syncs, key reuse, tracer branches); accepted warnings live in
+# tools/graph_lint_suppressions.txt (stale entries are themselves an
+# error). The --cost tier adds the HLO rules — zero collectives in
+# single-device serving steps, peak-HBM/flops under the committed
+# budgets, warmup bucket-coverage proof — and --cost-diff fails the
+# build when any surface's static flops / peak-HBM / collective bytes
+# regress >10% vs tools/cost_budgets.json (a hardware-free perf gate;
+# regenerate the manifest with --update-budgets when a regression is
+# intentional and justify it in the PR)
+echo "== graph self-lint + cost budgets (framework preset) =="
+python tools/graph_lint.py --preset framework --cost --cost-diff
 
 if [ "$MODE" = "--quick" ]; then
   echo "CI OK (quick tier)"
