@@ -791,6 +791,8 @@ SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                   "ttft_budget_s", "ttft_slo_met",
                   "queue_wait_p50_s", "queue_wait_p90_s",
                   "queue_wait_p99_s", "admit_to_first_token_p99_s",
+                  "slo_burn_rate", "slo_alerts_total",
+                  "trace_json", "trace_spans",
                   "prefix_variant",
                   "mean_slot_occupancy", "page_utilization_peak",
                   "decode_recompiles_after_warmup", "num_requests",
@@ -889,14 +891,22 @@ def run_bench_serving(dev, dryrun=False):
     cache_dtype = jnp.bfloat16 if not on_tpu else None
 
     reg = obs.MetricsRegistry()
+    # request-lifecycle tracing is ON for the whole bench (ISSUE 10
+    # acceptance: the bench emits a Perfetto-loadable .trace.json in
+    # which a request's spans reconstruct its full lifecycle) — tracing
+    # is host-side only, so the zero-recompile assertions below also
+    # prove the invariant holds WITH tracing enabled
+    tracer = obs.Tracer(capacity=32768)
     # main mix runs WITHOUT prefix sharing: the prompts are distinct, and
     # the engine-vs-dense comparison must not quietly reuse pages across
-    # the two timing passes; sharing is measured by the prefix variant
+    # the two timing passes; sharing is measured by the prefix variant.
+    # ttft_budget_s arms the SLO burn-rate monitor over the same budget
+    # the percentile keys are judged against.
     eng = serving.ServingEngine(
         model, params, num_slots=num_slots, page_size=page_size,
         max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
         attn_impl=attn_impl, cache_dtype=cache_dtype, registry=reg,
-        prefix_sharing=False)
+        prefix_sharing=False, tracer=tracer, ttft_budget_s=ttft_budget)
     # startup compiles happen here (every gather bucket + the prefill
     # chunk), so everything timed below is steady-state serving
     eng.warmup()
@@ -1050,7 +1060,7 @@ def run_bench_serving(dev, dryrun=False):
         model, params, num_slots=num_slots, page_size=page_size,
         max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
         attn_impl=attn_impl, cache_dtype=cache_dtype, registry=reg2,
-        prefix_sharing=True)
+        prefix_sharing=True, tracer=tracer)
     eng2.warmup()
     det2 = obs.RecompileDetector("serving_bench_prefix", warmup=0,
                                  registry=reg2)
@@ -1098,6 +1108,68 @@ def run_bench_serving(dev, dryrun=False):
         "recompiles": det2.recompiles,
     }
 
+    # --- trace canary: a tiny engine with a deliberately starved page
+    # pool + an EDF-boosted deadline, so the exported timeline ALWAYS
+    # carries scheduler-decision annotations (sched_skip / sched_boost)
+    # next to the measured passes' request lifecycles — the decisions
+    # depend on saturation timing in the measured mix, the canary makes
+    # them deterministic. Runs after det/det2.check(), on its own
+    # registry, so its compiles never pollute the recompile accounting.
+    ccfg = GPTConfig.tiny(vocab_size=64, hidden_size=16, num_layers=1,
+                          num_heads=2, ffn_size=32, max_position=32,
+                          dropout=0.0, attn_impl="xla")
+    cmodel = GPT(ccfg)
+    cparams = cmodel.init(jax.random.PRNGKey(1))
+    eng3 = serving.ServingEngine(
+        cmodel, cparams, num_slots=2, page_size=4,
+        max_tokens_per_slot=16, num_pages=5, prefill_chunk=4,
+        attn_impl="lax", registry=obs.MetricsRegistry(), tracer=tracer,
+        prefix_sharing=False)
+    eng3.warmup(cost_gauges=False)
+    canary = np.arange(1, 9, dtype=np.int32)
+    eng3.submit(canary, 8)                   # takes all 4 usable pages
+    eng3.scheduler.note_ttft(10.0)           # seed the TTFT estimator
+    # deadline < EWMA estimate -> at-risk -> sched_boost; no pages while
+    # the first request runs -> sched_skip per admission pass
+    eng3.submit(canary, 8, lane="interactive", ttft_deadline_s=5.0)
+    csteps = 0
+    while not eng3.scheduler.idle():
+        eng3.step()
+        csteps += 1
+        if csteps > 10_000:
+            raise RuntimeError("trace canary did not converge")
+
+    # --- trace artifact: self-validate the Perfetto contract + the
+    # lifecycle-reconstruction acceptance before writing it next to
+    # BENCH_SERVING.json
+    all_spans = tracer.spans()          # one ring snapshot, then index
+    req_spans = [s for s in all_spans if s.name == "serving.request"]
+    traces_by_name = {}
+    for s in all_spans:
+        traces_by_name.setdefault(s.name, set()).add(s.trace_id)
+    ev_names = {e[1] for s in req_spans for e in s.events}
+    for needed in ("submitted", "admitted", "first_token", "finished",
+                   "prefix_shared", "sched_skip", "sched_boost"):
+        if needed not in ev_names:
+            raise RuntimeError(
+                f"trace self-check: no {needed!r} event in any "
+                "serving.request span")
+    full = [s for s in req_spans if s.end is not None
+            and s.trace_id in traces_by_name.get("serving.prefill_chunk",
+                                                 ())
+            and s.trace_id in traces_by_name.get("serving.decode_block",
+                                                 ())]
+    if not full:
+        raise RuntimeError("trace self-check: no request trace "
+                           "reconstructs queue->prefill->decode->finish")
+    chrome = tracer.to_chrome()
+    obs.chrome_trace_valid(chrome, require_events=len(full))
+    jpath = serving_json_path(dryrun)
+    trace_path = (jpath[:-5] if jpath.endswith(".json") else jpath) \
+        + ".trace.json"
+    with open(trace_path, "w") as f:
+        json.dump(chrome, f)
+
     ttft_p = ep["ttft_q"]
     qw_p = ep["qw_q"]
     result = {
@@ -1126,6 +1198,14 @@ def run_bench_serving(dev, dryrun=False):
         "queue_wait_p90_s": round(qw_p[0.9], 6),
         "queue_wait_p99_s": round(qw_p[0.99], 6),
         "admit_to_first_token_p99_s": round(ep["a2f_p99"], 6),
+        # burn-rate monitor state at bench end: the burst mix BLOWS the
+        # interactive budget by construction (batch-lane TTFT is
+        # backlog-dominated), so a nonzero alert count here is the
+        # monitor working, not a failure
+        "slo_burn_rate": round(eng.slo_monitor.burn["fast"], 4),
+        "slo_alerts_total": eng.slo_monitor.alerts_total,
+        "trace_json": trace_path,
+        "trace_spans": len(tracer.spans()),
         "prefix_variant": prefix_variant,
         "mean_slot_occupancy": round(float(np.mean(occ)), 4),
         "page_utilization_peak": round(peak_util, 4),
@@ -1157,10 +1237,15 @@ def run_bench_serving(dev, dryrun=False):
         raise RuntimeError("prefix-sharing variant recompiled "
                            f"{prefix_variant['recompiles']}x — CoW/"
                            "prefill shapes drifted")
+    import os
     path = serving_json_path(dryrun)
+    committed = {k: v for k, v in result.items() if k != "_telemetry"}
+    # the checked-in artifact must be portable across checkouts: the
+    # trace sits next to this JSON, so record the basename (the stdout
+    # result keeps the absolute path for run_ci / tooling)
+    committed["trace_json"] = os.path.basename(trace_path)
     with open(path, "w") as f:
-        json.dump({k: v for k, v in result.items()
-                   if k != "_telemetry"}, f, indent=2)
+        json.dump(committed, f, indent=2)
     result["bench_json"] = path
     return result
 

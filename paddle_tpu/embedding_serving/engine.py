@@ -100,6 +100,7 @@ class _Pending:
     hits: int
     submitted_at: float
     pull_issued_at: float
+    span: object = None              # trace root (None = tracing off)
 
 
 class EmbeddingServingEngine:
@@ -118,7 +119,7 @@ class EmbeddingServingEngine:
                  min_bucket: int = 256, max_pending: int = 4,
                  channel=None, max_staleness_s: Optional[float] = None,
                  max_lag_updates: Optional[int] = None,
-                 cache_dtype=None, registry=None):
+                 cache_dtype=None, registry=None, tracer=None):
         import jax
 
         self.store = store
@@ -130,6 +131,9 @@ class EmbeddingServingEngine:
         self.max_lag_updates = max_lag_updates
         from paddle_tpu import observability as obs
         self._reg = registry or obs.default()
+        # per-batch lifecycle tracing (host-side only — no jitted code
+        # is touched): dedup → miss pull → install → gather → predict
+        self.tracer = tracer or obs.tracing.default()
         self.cache = DeviceEmbeddingCache(
             capacity, store.dim, policy=policy, dtype=cache_dtype,
             min_gather_bucket=min_bucket, registry=self._reg)
@@ -202,6 +206,11 @@ class EmbeddingServingEngine:
                 retry_after_s=max(
                     self._miss_h().summary()["mean"], 1e-4))
             self._reject_c.inc(reason=rej.reason)
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "embed.request", duration_s=0.0, status="shed",
+                    shed_reason=rej.reason,
+                    queue_depth=rej.queue_depth)
             raise EmbeddingLoadShedError(rej)
         self._req_c.inc()
         feat_ids = np.asarray(feat_ids, np.int64)
@@ -234,9 +243,19 @@ class EmbeddingServingEngine:
             sr = self._stale_req
             req = {i: sr[i] for i in miss_ids.tolist() if i in sr}
         self._rid += 1
+        span = None
+        if self.tracer.enabled:
+            span = self.tracer.start_span(
+                "embed.request", rid=self._rid, batch=int(inv.size),
+                uniq=int(uniq.size), misses=int(miss_ids.size),
+                hit_occurrences=hits)
+            span.add_event("dedup", uniq=int(uniq.size),
+                           misses=int(miss_ids.size))
+            if handle is not None:
+                span.add_event("pull_issued", rows=int(miss_ids.size))
         self._pending.append(_Pending(
             self._rid, uniq, set(uniq.tolist()), inv, feat_vals, handle,
-            miss_ids, req, hits, now, time.monotonic()))
+            miss_ids, req, hits, now, time.monotonic(), span))
         return self._rid
 
     def step(self) -> Dict[int, np.ndarray]:
@@ -248,11 +267,31 @@ class EmbeddingServingEngine:
         if not self._pending:
             return {}
         p = self._pending.popleft()
+        try:
+            return self._step_popped(p)
+        except BaseException:
+            # the batch is already popped: its root span would otherwise
+            # never reach the ring — and the FAILING request's trace is
+            # the one an operator needs most
+            if p.span is not None:
+                p.span.add_event("error")
+                p.span.finish(status="error")
+            raise
+
+    def _step_popped(self, p: _Pending) -> Dict[int, np.ndarray]:
         if p.handle is not None:
+            t0 = time.monotonic()
             rows = p.handle.wait()
-            self._miss_h().observe(time.monotonic() - p.pull_issued_at)
+            t1 = time.monotonic()
+            self._miss_h().observe(t1 - p.pull_issued_at)
+            if p.span is not None:
+                self.tracer.record_span(
+                    "embed.pull_wait", start=t0, end=t1, parent=p.span,
+                    rows=int(p.miss_ids.size),
+                    pull_age_s=round(t1 - p.pull_issued_at, 6))
             protect = p.uniq_set.union(
                 *(q.uniq_set for q in self._pending))
+            t0 = time.monotonic()
             try:
                 self.cache.install(p.miss_ids, np.asarray(rows),
                                    versions=p.req or None,
@@ -262,9 +301,16 @@ class EmbeddingServingEngine:
                 # table: protect only THIS batch (capacity must hold
                 # one batch — submit's hard check). Later batches whose
                 # hit-classified rows get evicted here self-heal below.
+                if p.span is not None:
+                    p.span.add_event("capacity_retry",
+                                     protected=len(p.uniq_set))
                 self.cache.install(p.miss_ids, np.asarray(rows),
                                    versions=p.req or None,
                                    protect=p.uniq_set)
+            if p.span is not None:
+                self.tracer.record_span(
+                    "embed.install", start=t0, parent=p.span,
+                    rows=int(p.miss_ids.size))
             self._settle_stale(p.req)
         # self-heal: a row classified as a hit at submit may have been
         # evicted since (a later batch's install under capacity
@@ -275,12 +321,16 @@ class EmbeddingServingEngine:
             sr = self._stale_req
             req2 = {i: sr[i] for i in gone.tolist() if i in sr} \
                 if sr else {}
+            if p.span is not None:
+                p.span.add_event("self_heal_repull",
+                                 rows=int(gone.size))
             self.cache.install(gone, self.store.pull(gone),
                                versions=req2 or None,
                                protect=p.uniq_set)
             self._settle_stale(req2)
         u_pad = _pow2_bucket(p.uniq.size, self.cache.min_gather_bucket,
                              max(self.cache.capacity, p.uniq.size))
+        t0 = time.monotonic()
         rows_dev = self.cache.gather(p.uniq, pad_to=u_pad)
         if self.model is not None:
             import jax.numpy as jnp
@@ -293,10 +343,18 @@ class EmbeddingServingEngine:
             out = np.asarray(out)
         else:
             out = np.asarray(rows_dev)
+        now = time.monotonic()
+        if p.span is not None:
+            self.tracer.record_span(
+                "embed.gather_forward", start=t0, end=now, parent=p.span,
+                uniq=int(p.uniq.size), pad_to=int(u_pad),
+                model=self.model is not None)
+            p.span.add_event("finished")
+            p.span.finish()
         self._served_rows += int(p.inv.size)
         self._served_hits += p.hits
         self._hit_g.set(self._served_hits / max(self._served_rows, 1))
-        self._lookup_h().observe(time.monotonic() - p.submitted_at)
+        self._lookup_h().observe(now - p.submitted_at)
         self._results[p.rid] = out
         while len(self._results) > self._results_cap:
             self._results.popitem(last=False)
@@ -344,7 +402,12 @@ class EmbeddingServingEngine:
                 and lag_s > self.max_staleness_s) or \
                 (self.max_lag_updates is not None
                  and lag_n > self.max_lag_updates):
+            t0 = time.monotonic()
             ch.flush()          # hard bound: apply the backlog first
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "embed.staleness_flush", start=t0,
+                    lag_seconds=round(lag_s, 6), lag_updates=lag_n)
             lag_s, lag_n = 0.0, 0
         self._stale_g.set(lag_s)
         self._lag_g.set(lag_n)

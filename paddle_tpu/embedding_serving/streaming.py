@@ -44,7 +44,7 @@ class StreamingUpdateChannel:
     engine's backing store."""
 
     def __init__(self, store, *, max_merge: int = 32,
-                 queue_size: int = 256, registry=None):
+                 queue_size: int = 256, registry=None, tracer=None):
         self.store = store
         self.max_merge = int(max_merge)
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
@@ -61,6 +61,10 @@ class StreamingUpdateChannel:
 
         from paddle_tpu import observability as obs
         self._reg = registry or obs.default()
+        # applier-thread spans live on the worker's OWN thread-local
+        # stack (a fresh trace per apply) — the thread-correct
+        # attribution the tracing module's design notes call out
+        self.tracer = tracer or obs.tracing.default()
         self._apply_h = self._reg.histogram(
             "embedding_stream_apply_seconds",
             "store-apply wall time per merged push batch")
@@ -205,7 +209,12 @@ class StreamingUpdateChannel:
             i = j
         self.applied_batches += 1
         self._applied_c.inc(applied)
-        self._apply_h.observe(time.monotonic() - t0)
+        now = time.monotonic()
+        self._apply_h.observe(now - t0)
+        if self.tracer.enabled:
+            self.tracer.record_span("embed.stream_apply", start=t0,
+                                    end=now, rows=applied,
+                                    merged_pushes=len(items))
 
     # -- lifecycle --------------------------------------------------------
 

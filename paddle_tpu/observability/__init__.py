@@ -1,23 +1,31 @@
-"""Runtime telemetry subsystem: structured run metrics, recompilation /
-step tracing, and cross-host aggregation.
+"""Runtime telemetry subsystem: metrics, traces, and live exposition.
 
-Three parts (ISSUE 1 / TensorFlow-paper-style first-class telemetry):
+Three pillars (ISSUE 1 + ISSUE 10 / TensorFlow-paper-style first-class
+telemetry):
 
-1. **Metrics registry** (`registry.py`): process-wide named Counter /
-   Gauge / Histogram with labels; Prometheus text exposition
-   (:func:`render_prometheus`); flat :func:`snapshot` for logs.
-2. **Run log + hot-path instrumentation** (`runlog.py`, `telemetry.py`,
-   `recompile.py`): crash-safe JSONL (one record per step), the
-   :class:`StepTelemetry` driver wired into ``Trainer.fit`` /
-   ``Executor.train_from_dataset``, a :class:`RecompileDetector` over
-   ``jax.monitoring`` compile events, and per-device memory gauges.
-3. **Cross-host aggregation** (`aggregate.py`): :func:`aggregate`
-   all-gathers scalars so host 0 sees min/max/mean per-host skew.
+1. **Metrics** (`registry.py`, `runlog.py`, `telemetry.py`,
+   `recompile.py`, `aggregate.py`): process-wide named Counter / Gauge /
+   Histogram with labels (thread-safe, with a lock-protected bound-child
+   hot path); Prometheus text exposition; crash-safe JSONL run logs;
+   the :class:`StepTelemetry` driver wired into ``Trainer.fit`` /
+   ``Executor.train_from_dataset``; a :class:`RecompileDetector` over
+   ``jax.monitoring`` compile events; cross-host min/mean/max skew.
+2. **Traces** (`tracing.py`): request-lifecycle spans in a bounded ring
+   buffer — thread-local span stacks, zero-cost-when-disabled no-op
+   spans, JSONL + Chrome-trace (Perfetto) exporters — instrumenting the
+   serving engines, scheduler decisions, Trainer steps, and snapshot
+   save/restore. ``profiler.record_event`` regions fold into the same
+   timeline.
+3. **Live exposition + SLO monitoring** (`exposition.py`, `slo.py`):
+   an opt-in stdlib HTTP endpoint serving ``/metrics`` / ``/healthz`` /
+   ``/traces`` from a running process, and a multi-window burn-rate
+   monitor over the latency histograms (``slo_burn_rate`` gauge,
+   edge-triggered ``slo_alerts_total`` alerts into metrics AND trace).
 
-``profiler.record_event`` spans feed the same registry, so one
-:func:`report` call dumps a unified summary.
+One :func:`report` call dumps a unified summary across all three.
 """
 
+from paddle_tpu.observability import exposition, slo, tracing
 from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
                                                MetricsRegistry, counter,
                                                default, gauge, histogram)
@@ -33,6 +41,11 @@ from paddle_tpu.observability.telemetry import (StepTelemetry,
                                                 device_memory_stats,
                                                 record_memory_gauges)
 from paddle_tpu.observability.report import SPAN_METRIC, report
+from paddle_tpu.observability.tracing import (Span, Tracer,
+                                              chrome_trace_valid,
+                                              validate_trace_log)
+from paddle_tpu.observability.exposition import ExpositionServer
+from paddle_tpu.observability.slo import BurnRateMonitor
 
 
 def render_prometheus(reg: MetricsRegistry = None) -> str:
@@ -74,4 +87,7 @@ __all__ = [
     "aggregate", "format_aggregate", "StepTelemetry",
     "device_memory_stats", "record_memory_gauges", "SPAN_METRIC",
     "report", "render_prometheus", "snapshot", "observe_span",
+    "Span", "Tracer", "validate_trace_log", "chrome_trace_valid",
+    "ExpositionServer", "BurnRateMonitor",
+    "tracing", "exposition", "slo",
 ]

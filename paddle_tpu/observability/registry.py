@@ -40,9 +40,17 @@ def _fmt_labels(key: LabelKey) -> str:
 
 
 class _Metric:
-    """Shared series bookkeeping; subclasses define the per-series cell."""
+    """Shared series bookkeeping; subclasses define the per-series cell.
+
+    Thread-safety contract (audited under concurrent writers — serving
+    step thread vs streaming applier vs snapshot writer): every
+    label-map mutation AND every cell read/write happens under
+    ``self._lock``; :meth:`child` is the lock-protected child-creation
+    path that binds a label set once so hot-path updates skip the
+    per-call label-key sort and double lock acquisition."""
 
     kind = "untyped"
+    _child_cls: type = None
 
     def __init__(self, name: str, help: str = ""):
         if not name or any(c in name for c in " \t\n{}\","):
@@ -60,15 +68,46 @@ class _Metric:
                 cell = self._series[key] = self._new_cell()
             return cell
 
+    def child(self, **labels):
+        """Bind one label set to a reusable handle (prometheus-client
+        ``labels()`` convention): cell creation is lock-protected here,
+        and the handle's updates are a single lock acquisition with no
+        label-key sorting — the hot-path form for per-step metrics."""
+        return self._child_cls(self, self._cell(labels))
+
     def labels_seen(self) -> List[LabelKey]:
         with self._lock:
             return list(self._series)
+
+
+class _BoundChild:
+    """A (metric, cell) pair: pre-resolved series handle."""
+
+    __slots__ = ("_metric", "_cell")
+
+    def __init__(self, metric: _Metric, cell):
+        self._metric = metric
+        self._cell = cell
+
+
+class _CounterChild(_BoundChild):
+    def inc(self, n: float = 1.0):
+        if n < 0:
+            raise ValueError(
+                f"counter {self._metric.name} cannot decrease (n={n})")
+        with self._metric._lock:
+            self._cell[0] += n
+
+    def value(self) -> float:
+        with self._metric._lock:
+            return self._cell[0]
 
 
 class Counter(_Metric):
     """Monotonically increasing count (reference: per-op run counters)."""
 
     kind = "counter"
+    _child_cls = _CounterChild
 
     def _new_cell(self):
         return [0.0]
@@ -82,13 +121,30 @@ class Counter(_Metric):
         return self
 
     def value(self, **labels) -> float:
-        return self._cell(labels)[0]
+        cell = self._cell(labels)
+        with self._lock:
+            return cell[0]
+
+
+class _GaugeChild(_BoundChild):
+    def set(self, v: float):
+        with self._metric._lock:
+            self._cell[0] = float(v)
+
+    def inc(self, n: float = 1.0):
+        with self._metric._lock:
+            self._cell[0] += n
+
+    def value(self) -> float:
+        with self._metric._lock:
+            return self._cell[0]
 
 
 class Gauge(_Metric):
     """Point-in-time value (memory bytes, queue depth, worker id)."""
 
     kind = "gauge"
+    _child_cls = _GaugeChild
 
     def _new_cell(self):
         return [0.0]
@@ -106,7 +162,9 @@ class Gauge(_Metric):
         return self
 
     def value(self, **labels) -> float:
-        return self._cell(labels)[0]
+        cell = self._cell(labels)
+        with self._lock:
+            return cell[0]
 
 
 # default buckets suit step/span latencies (seconds): 100us .. 100s
@@ -125,6 +183,11 @@ class _HistCell:
         self.max = -math.inf
 
 
+class _HistogramChild(_BoundChild):
+    def observe(self, v: float):
+        self._metric._observe_cell(self._cell, float(v))
+
+
 class Histogram(_Metric):
     """Cumulative-bucket histogram + running min/max/sum/count.
 
@@ -132,6 +195,7 @@ class Histogram(_Metric):
     host skew view and the report() table."""
 
     kind = "histogram"
+    _child_cls = _HistogramChild
 
     def __init__(self, name: str, help: str = "",
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
@@ -144,8 +208,10 @@ class Histogram(_Metric):
         return _HistCell(len(self.buckets))
 
     def observe(self, v: float, **labels) -> "Histogram":
-        v = float(v)
-        cell = self._cell(labels)
+        self._observe_cell(self._cell(labels), float(v))
+        return self
+
+    def _observe_cell(self, cell: _HistCell, v: float):
         with self._lock:
             i = 0
             for i, b in enumerate(self.buckets):
@@ -158,7 +224,6 @@ class Histogram(_Metric):
             cell.sum += v
             cell.min = min(cell.min, v)
             cell.max = max(cell.max, v)
-        return self
 
     def summary(self, **labels) -> Dict[str, float]:
         cell = self._cell(labels)
@@ -202,6 +267,73 @@ class Histogram(_Metric):
     def percentiles(self, *qs: float, **labels) -> Dict[str, float]:
         """{'p50': ..., 'p99': ...} for the given quantiles (0-1)."""
         return {f"p{q * 100:g}": self.quantile(q, **labels) for q in qs}
+
+    def count_and_over(self, v: float, **labels):
+        """(total, definitely-over-``v``) in ONE lock acquisition — the
+        SLO monitor's atomic read: separate total/over reads could let a
+        concurrent observe land between them and mint a phantom
+        violation. "Over" is conservative: only buckets whose entire
+        range lies above ``v`` count (samples sharing ``v``'s own bucket
+        are treated as within budget) — a mid-bucket budget must never
+        page on traffic that actually met it; put budgets on bucket
+        edges for exact accounting."""
+        v = float(v)
+        cell = self._cell(labels)
+        with self._lock:
+            total = float(cell.count)
+            if not cell.count or v >= cell.max:
+                return total, 0.0
+            if v < cell.min:
+                return total, total
+            over = 0.0
+            lo = -math.inf
+            for i, c in enumerate(cell.counts):
+                if lo >= v:
+                    over += c
+                lo = (self.buckets[i] if i < len(self.buckets)
+                      else math.inf)
+            return total, over
+
+    def count_over(self, v: float, **labels) -> float:
+        """Number of observations definitely > ``v`` (see
+        :meth:`count_and_over` for the semantics and the atomic pair)."""
+        return self.count_and_over(v, **labels)[1]
+
+    def count_le(self, v: float, **labels) -> float:
+        """Estimated number of observations <= ``v`` (the inverse of
+        :meth:`quantile`, same bucket interpolation; see
+        :meth:`count_over` for the conservative SLO-side count)."""
+        v = float(v)
+        cell = self._cell(labels)
+        with self._lock:
+            if not cell.count:
+                return 0.0
+            if v >= cell.max:
+                return float(cell.count)
+            if v < cell.min:
+                return 0.0
+            cum = 0.0
+            lo = cell.min
+            for i, c in enumerate(cell.counts):
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else cell.max)
+                if v <= hi:
+                    if c and hi > lo:
+                        frac = max(min((v - lo) / (hi - lo), 1.0), 0.0)
+                        return cum + frac * c
+                    return cum + (c if v >= hi else 0.0)
+                cum += c
+                lo = hi
+            return float(cell.count)
+
+    def _render_cell(self, labels: Dict[str, object]):
+        """Consistent (counts, count, sum) snapshot for exposition —
+        taken under the metric lock, so a concurrent ``observe`` can
+        never produce a render whose bucket total disagrees with its
+        ``_count`` line (the torn read the thread-safety audit found)."""
+        cell = self._cell(labels)
+        with self._lock:
+            return list(cell.counts), cell.count, cell.sum
 
 
 class MetricsRegistry:
@@ -275,18 +407,18 @@ class MetricsRegistry:
             for key in keys:
                 labels = dict(key)
                 if isinstance(m, Histogram):
-                    cell = m._cell(labels)
+                    counts, count, total = m._render_cell(labels)
                     cum = 0
-                    for b, c in zip(m.buckets, cell.counts):
+                    for b, c in zip(m.buckets, counts):
                         cum += c
                         lab = _fmt_labels(key + (("le", _fmt_le(b)),))
                         lines.append(f"{m.name}_bucket{lab} {cum}")
-                    cum += cell.counts[-1]
+                    cum += counts[-1]
                     lab = _fmt_labels(key + (("le", "+Inf"),))
                     lines.append(f"{m.name}_bucket{lab} {cum}")
                     lab = _fmt_labels(key)
-                    lines.append(f"{m.name}_sum{lab} {_fmt_num(cell.sum)}")
-                    lines.append(f"{m.name}_count{lab} {cell.count}")
+                    lines.append(f"{m.name}_sum{lab} {_fmt_num(total)}")
+                    lines.append(f"{m.name}_count{lab} {count}")
                 else:
                     lab = _fmt_labels(key)
                     lines.append(

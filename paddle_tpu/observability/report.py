@@ -1,8 +1,9 @@
 """Unified run summary: one ``report()`` call renders everything the
-registry saw — counters, gauges, histograms, and the profiler's
-``record_event`` spans (which feed the same registry) — as one text
-block. The reference's sorted profiler summary, generalized to the whole
-telemetry surface.
+registry saw — counters, gauges, histograms, the profiler's
+``record_event`` spans (which feed the same registry), the tracer's
+ring-buffer spans per subsystem, and the SLO burn-rate/alert state — as
+one text block. The reference's sorted profiler summary, generalized to
+the whole telemetry surface.
 """
 
 from __future__ import annotations
@@ -10,15 +11,18 @@ from __future__ import annotations
 from typing import List, Optional
 
 from paddle_tpu.observability import registry as _registry
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
                                                _fmt_labels)
 
 SPAN_METRIC = "record_event_span_seconds"
 
 
-def report(reg: Optional[_registry.MetricsRegistry] = None) -> str:
+def report(reg: Optional[_registry.MetricsRegistry] = None,
+           tracer: Optional[_tracing.Tracer] = None) -> str:
     """Render the unified observability summary."""
     reg = reg or _registry.default()
+    tracer = tracer or _tracing.default()
     scalars: List[str] = []
     hists: List[str] = []
     spans: List[tuple] = []
@@ -55,6 +59,40 @@ def report(reg: Optional[_registry.MetricsRegistry] = None) -> str:
             lines.append(
                 f"{name:<32}{s['count']:>8}{s['sum']:>12.4f}"
                 f"{1e3 * s['mean']:>12.3f}{1e3 * s['max']:>12.3f}")
+    trace_summary = tracer.summary()
+    if trace_summary:
+        lines.append("-- trace spans --")
+        lines.append(f"{'Span':<32}{'Count':>8}{'Total(s)':>12}")
+        for name, a in sorted(trace_summary.items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:<32}{a['count']:>8.0f}"
+                         f"{a['total_s']:>12.4f}")
+        if tracer.dropped:
+            lines.append(f"(ring dropped {tracer.dropped} older spans)")
+    slo_lines = _slo_lines(reg)
+    if slo_lines:
+        lines.append("-- slo --")
+        lines.extend(slo_lines)
     if len(lines) == 1:
         lines.append("(no metrics recorded)")
     return "\n".join(lines)
+
+
+def _slo_lines(reg: _registry.MetricsRegistry) -> List[str]:
+    """Current burn rates + alert counts, when SLO monitoring ran."""
+    out: List[str] = []
+    burn = reg.get("slo_burn_rate")
+    if isinstance(burn, Gauge):
+        for key in sorted(burn.labels_seen()):
+            labels = dict(key)
+            out.append(f"burn_rate slo={labels.get('slo', '?')} "
+                       f"window={labels.get('window', '?')} "
+                       f"{burn.value(**labels):.4g}")
+    alerts = reg.get("slo_alerts_total")
+    if isinstance(alerts, Counter):
+        for key in sorted(alerts.labels_seen()):
+            labels = dict(key)
+            out.append(f"alerts slo={labels.get('slo', '?')} "
+                       f"severity={labels.get('severity', '?')} "
+                       f"{alerts.value(**labels):.0f}")
+    return out

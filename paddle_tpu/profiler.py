@@ -33,16 +33,23 @@ _EVENTS = _Events()
 @contextlib.contextmanager
 def record_event(name: str):
     """Annotate a region: shows up in device traces (named_scope → XLA op
-    metadata), in the host event table under :func:`profiler`, and —
-    always — in the observability registry's span histogram, so
-    ``observability.report()`` covers record_event spans without a
-    profiler context. (Inside jit the span fires once per TRACE, not per
-    execution — host spans measure host work.)"""
+    metadata), in the host event table under :func:`profiler`, in the
+    observability registry's span histogram (so ``observability.report()``
+    covers record_event spans without a profiler context), and — when the
+    default tracer is enabled — in the request-trace timeline, parented
+    to the calling thread's current span. (Inside jit the span fires once
+    per TRACE, not per execution — host spans measure host work.)"""
     t0 = time.perf_counter()
     with jax.named_scope(name):
         yield
     dt = time.perf_counter() - t0
     _obs.observe_span(name, dt)
+    tr = _obs.tracing.default()
+    if tr.enabled:
+        # duration-only record: perf_counter and the tracer's monotonic
+        # clock may differ in epoch, so let the tracer place the span at
+        # its own "now" minus the measured duration
+        tr.record_span(name, duration_s=dt, cat="record_event")
     if _EVENTS.active is not None:
         _EVENTS.active.append((name, dt, t0))
 

@@ -236,24 +236,39 @@ class SnapshotEngine:
         for key, leaf in flat.items():
             shape, shards = _host_shards(leaf)
             leaves[key] = {"shape": shape, "shards": shards}
+        blocking_s = time.perf_counter() - t0
         observability.histogram(
             "resilience_snapshot_blocking_seconds",
             "host-copy time save() spends on the caller's thread").observe(
-                time.perf_counter() - t0)
+                blocking_s)
+        # a completed span on the CALLER's thread; the queue carries it
+        # so the writer thread's snapshot.write span parents to it —
+        # cross-thread parentage ties one save's host copy and its
+        # background write into a single trace
+        tracer = observability.tracing.default()
+        span = None
+        if tracer.enabled:
+            span = tracer.record_span("snapshot.save_blocking",
+                                      duration_s=blocking_s, step=step)
         # blocks when one save is already pending behind the in-flight one:
         # bounded memory, the caller feels backpressure instead of OOM
-        self._queue.put((int(step), leaves, t0))
+        self._queue.put((int(step), leaves, t0, span))
         if wait:
             self.wait_until_finished()
 
     def _drain(self):
+        tracer = observability.tracing.default()
         while True:
             job = self._queue.get()
             try:
                 if job is None:
                     return
-                step, leaves, t0 = job
+                step, leaves, t0, parent = job
+                tw0 = tracer.now()
                 self._write_snapshot(step, leaves)
+                if tracer.enabled:
+                    tracer.record_span("snapshot.write", start=tw0,
+                                       parent=parent, step=step)
                 observability.histogram(
                     "resilience_snapshot_seconds",
                     "save() start to manifest commit").observe(
@@ -536,10 +551,15 @@ class SnapshotEngine:
             "resilience_restore_max_region_bytes",
             "largest single host allocation the last restore made"
         ).set(float(max_region))
+        restore_s = time.perf_counter() - t0
         observability.histogram(
             "resilience_restore_seconds",
             "verified manifest to assembled host pytree").observe(
-                time.perf_counter() - t0)
+                restore_s)
+        tracer = observability.tracing.default()
+        if tracer.enabled:
+            tracer.record_span("snapshot.restore", duration_s=restore_s,
+                               step=step, sharded=shardings is not None)
         return tree
 
     def _check_target(self, target: Any, shapes: Dict[str, tuple]):

@@ -55,6 +55,7 @@ class Request:
     lane: str = "default"
     ttft_deadline_s: Optional[float] = None
     skips: int = 0                  # admission passes that skipped it
+    boosted: bool = False           # already EDF-boosted (one trace event)
 
     @property
     def total_tokens(self) -> int:
@@ -127,6 +128,14 @@ class ContinuousBatchingScheduler:
         self._can_admit = can_admit or (lambda r: True)
         self._clock = clock
         self._ids = itertools.count()
+        # decision-event sink: event_cb(rid, name, **attrs). The engine
+        # wires this to each request's trace span, so skip/boost/shed
+        # verdicts land on the request timeline with their reasons.
+        self.event_cb: Optional[Callable] = None
+
+    def _event(self, rid: int, name: str, **attrs):
+        if self.event_cb is not None:
+            self.event_cb(rid, name, **attrs)
 
     # -- queue ------------------------------------------------------------
 
@@ -304,6 +313,11 @@ class SLOScheduler(ContinuousBatchingScheduler):
             dl = req.deadline_at()
             if (dl is not None and self._ttft_ewma > 0.0
                     and now + self._ttft_ewma + self.deadline_slack_s >= dl):
+                if not req.boosted:     # one boost event per request
+                    req.boosted = True
+                    self._event(req.rid, "sched_boost",
+                                deadline_in_s=round(dl - now, 6),
+                                est_ttft_s=round(self._ttft_ewma, 6))
                 at_risk.append((dl, i, req))
             else:
                 rest.append((self.lane_order.get(req.lane, 0),
@@ -327,7 +341,16 @@ class SLOScheduler(ContinuousBatchingScheduler):
             if not self._can_admit(req):
                 req.skips += 1
                 if req.skips > self.starvation_skips:
+                    if req.skips == self.starvation_skips + 1:
+                        # once per request: admit() runs every step, and
+                        # a head-blocked request can stay blocked for
+                        # hours — per-pass events would grow its live
+                        # span without bound
+                        self._event(req.rid, "sched_block",
+                                    skips=req.skips)
                     break           # anti-starvation: now it head-blocks
+                self._event(req.rid, "sched_skip", skips=req.skips,
+                            reason="no_capacity")
                 continue
             slot = free.pop(0)
             self.queue.remove(req)

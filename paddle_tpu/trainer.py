@@ -140,9 +140,14 @@ class Trainer:
         # host-mirrored global step: one device sync here, none in the loop
         gstep = self.step_count
         try:
-            last_metrics = self._fit_epochs(
-                epochs, data_iter, make_iter, steps_per_epoch, tel, gstep,
-                lint=lint, lint_cost=lint_cost)
+            # trace root for the run: per-step spans (recorded inside the
+            # epoch loop when tracing is enabled) nest under it via the
+            # thread-local span stack; a no-op when tracing is disabled
+            with observability.tracing.default().span(
+                    "trainer.fit", epochs=epochs, start_step=gstep):
+                last_metrics = self._fit_epochs(
+                    epochs, data_iter, make_iter, steps_per_epoch, tel,
+                    gstep, lint=lint, lint_cost=lint_cost)
         finally:
             if tel is not None:
                 tel.close(summary={"metrics": last_metrics})
@@ -182,12 +187,19 @@ class Trainer:
                     tel.data_wait(data_wait_s)
                 t_step = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, **batch)
+                step_time_s = time.perf_counter() - t_step
                 n += 1
                 gstep += 1
+                tracer = observability.tracing.default()
+                if tracer.enabled:
+                    tracer.record_span("trainer.step",
+                                       duration_s=step_time_s,
+                                       step=gstep, epoch=epoch,
+                                       data_wait_s=round(data_wait_s, 6))
                 if tel is not None:
                     ex, tok = _batch_counts(batch, self.tokens_per_example)
                     tel.step(gstep, feeds=batch,
-                             step_time_s=time.perf_counter() - t_step,
+                             step_time_s=step_time_s,
                              examples=ex, tokens=tok, epoch=epoch)
                 if self.log_every and n % self.log_every == 0:
                     last_metrics = {k: float(v) for k, v in metrics.items()}

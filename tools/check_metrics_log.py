@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""Schema-validate a JSONL telemetry run log (observability.runlog).
+"""Schema-validate JSONL telemetry logs (observability.runlog / tracing).
 
 Usage:
     python tools/check_metrics_log.py RUN.jsonl [--require-steps N]
+    python tools/check_metrics_log.py --trace TRACE.jsonl [--require-spans N]
 
-Exit 0 when every record validates (and at least N step records exist);
-exit 1 with a precise message otherwise. The bench scripts run this over
-their own logs so malformed telemetry fails fast instead of polluting
-the BENCH_* trajectory; CI can point it at any training run log.
+Exit 0 when every record validates (and at least N step/span records
+exist); exit 1 with a precise message otherwise. The bench scripts run
+this over their own logs so malformed telemetry fails fast instead of
+polluting the BENCH_* trajectory; CI can point it at any training run
+log or trace export (``Tracer.export_jsonl``).
 """
 
 from __future__ import annotations
@@ -21,19 +23,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("path", help="JSONL run log to validate")
+    ap.add_argument("path", help="JSONL log to validate")
     ap.add_argument("--require-steps", type=int, default=0,
                     help="fail unless at least N step records are present")
+    ap.add_argument("--trace", action="store_true",
+                    help="validate as a trace-span export "
+                         "(Tracer.export_jsonl schema) instead of a "
+                         "metrics run log")
+    ap.add_argument("--require-spans", type=int, default=0,
+                    help="with --trace: fail unless at least N span "
+                         "records are present")
     args = ap.parse_args(argv)
+    # a mismatched flag/mode combination must fail fast, not silently
+    # validate with no minimum-count gate
+    if args.trace and args.require_steps:
+        ap.error("--require-steps applies to run logs; "
+                 "use --require-spans with --trace")
+    if args.require_spans and not args.trace:
+        ap.error("--require-spans only applies with --trace")
 
-    from paddle_tpu.observability import runlog
     try:
-        n = runlog.validate_run_log(args.path,
-                                    require_steps=args.require_steps)
+        if args.trace:
+            from paddle_tpu.observability import tracing
+            n = tracing.validate_trace_log(
+                args.path, require_spans=args.require_spans)
+            what = "span"
+        else:
+            from paddle_tpu.observability import runlog
+            n = runlog.validate_run_log(args.path,
+                                        require_steps=args.require_steps)
+            what = "step"
     except (OSError, ValueError) as e:
         print(f"check_metrics_log: FAIL: {e}", file=sys.stderr)
         return 1
-    print(f"check_metrics_log: OK: {args.path} ({n} step records)")
+    print(f"check_metrics_log: OK: {args.path} ({n} {what} records)")
     return 0
 
 

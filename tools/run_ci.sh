@@ -50,14 +50,27 @@ import json, sys
 r = json.load(sys.stdin)
 for k in ("ttft_p50_s", "ttft_p90_s", "ttft_p99_s", "ttft_budget_s",
           "queue_wait_p99_s", "admit_to_first_token_p99_s",
-          "prefix_variant"):
+          "prefix_variant", "slo_burn_rate", "slo_alerts_total",
+          "trace_json", "trace_spans"):
     assert k in r, f"BENCH_SERVING missing {k}"
 assert r["ttft_slo_met"], "dryrun TTFT p99 blew the stated budget"
 pv = r["prefix_variant"]
 assert pv["prefill_tokens_computed"] < pv["prompt_tokens_submitted"], \
     "prefix sharing saved no prefill work"
 assert pv["recompiles"] == 0 and r["decode_recompiles_after_warmup"] == 0
-print("serving dryrun prefill metrics OK")
+# the ISSUE 10 trace artifact: present, Perfetto-valid (every event
+# carries ph/ts/pid/tid), and carrying the lifecycle + decision
+# annotations the bench self-check pinned
+from paddle_tpu.observability import tracing
+trace = json.load(open(r["trace_json"]))
+n = tracing.chrome_trace_valid(trace, require_events=r["trace_spans"])
+names = {e["name"] for e in trace["traceEvents"]}
+for needed in ("serving.request", "serving.prefill_chunk",
+               "serving.decode_block", "prefix_shared", "sched_skip",
+               "sched_boost"):
+    assert needed in names, f"trace artifact missing {needed!r}"
+assert r["trace_spans"] > 0, "empty trace ring"
+print(f"serving dryrun prefill+SLO+trace metrics OK ({n} trace events)")
 '
 
 # embedding-serving bench smoke: the device-cached host-KV lookup engine
