@@ -780,6 +780,321 @@ def run_bench_embedding_serving(dev, dryrun=False):
     return result
 
 
+ROUTER_SCHEMA = ("metric", "value", "unit", "vs_baseline",
+                 "aggregate_tokens_per_sec", "replica_scaling",
+                 "scaling_2x", "scaling_4x",
+                 "ttft_interactive_p99_s", "ttft_budget_s",
+                 "ttft_slo_met", "migrations", "migration_parity_ok",
+                 "affinity_routed", "balance_routed",
+                 "prefix_tokens_shared",
+                 "recompiles_after_warmup", "num_requests",
+                 "replica_slots", "decode_cap",
+                 "trace_json", "trace_spans", "device")
+
+
+def router_json_path(dryrun: bool) -> str:
+    import os
+    if dryrun:  # CI smoke must not dirty the checkout
+        return os.environ.get("PADDLE_TPU_BENCH_ROUTER",
+                              "/tmp/BENCH_ROUTER.json")
+    return os.environ.get(
+        "PADDLE_TPU_BENCH_ROUTER",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_ROUTER.json"))
+
+
+def run_bench_router(dev, dryrun=False):
+    """Multi-replica serving fleet (ISSUE 11 acceptance): N paged
+    ServingEngine replicas behind the prefix-affinity FleetRouter.
+
+    Replicas are stepped round-robin on ONE host here, so wall-clock
+    cannot show fleet scaling; instead each replica's BUSY time (wall
+    seconds inside its own step calls) is measured and the fleet's
+    aggregate tokens/s is ``total tokens / max per-replica busy`` —
+    the critical path if every replica had its own accelerator, which
+    is exactly what the router controls: a balance miss concentrates
+    busy time on one replica and the scaling number drops. Legs:
+
+    - scaling: the same burst (fresh random prompts, same length mix)
+      through 1/2/4-replica fleets; ``scaling_2x = agg2/agg1`` with
+      the >=1.6x acceptance target;
+    - SLO probes: interactive-lane probes trickled in while a burst
+      that saturates a single engine runs on the 2-replica fleet —
+      probe TTFT p99 vs the stated budget;
+    - affinity: shared-system-prompt traffic after one publisher wave;
+      the router must place followers where the prefix pages are hot
+      (prefix_tokens_shared counts the skipped prefill);
+    - migration: the same burst run twice on 2 replicas, once clean and
+      once with a mid-decode drain of one replica (live migration of
+      queued + in-flight requests) — greedy outputs must be
+      byte-identical and the whole bench must stay at ZERO recompiles
+      fleet-wide (every replica fully warmed up front, migration page
+      IO included).
+
+    Emits BENCH_ROUTER.json (schema self-validated) next to this file
+    (dryrun: /tmp) plus a Perfetto trace whose router.route /
+    serving.request / router.migrate spans share trace ids across the
+    fleet."""
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=12,
+                        num_heads=16, ffn_size=4096, max_position=512,
+                        dropout=0.0)
+        n_req, slots, page_size, chunk, cap = 48, 8, 16, 64, 64
+        len_set = (16, 32, 64, 128, 192)
+        attn_impl = "pallas"
+        ttft_budget = 1.0
+        sysp_len = 4 * page_size + 2
+        decode_block = 8
+    elif dryrun:
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=32, num_layers=2,
+                             num_heads=2, ffn_size=64, max_position=64,
+                             dropout=0.0, attn_impl="xla")
+        n_req, slots, page_size, chunk, cap = 8, 2, 4, 8, 8
+        len_set = (4, 9, 12)
+        attn_impl = "lax"
+        ttft_budget = 30.0   # smoke box: schema/plumbing, not latency
+        sysp_len = page_size + 2   # fits the tiny per-slot limit
+        decode_block = 4     # < cap so a mid-decode drain window exists
+    else:
+        # CPU measurement config: weight-heavy so batching amortizes
+        # weight reads; small enough that 4 replicas' warmups fit a CI
+        # box. A single replica (4 slots) is saturated 8x over by the
+        # 32-request burst.
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=8, ffn_size=1024, max_position=192,
+                        dropout=0.0, attn_impl="xla")
+        n_req, slots, page_size, chunk, cap = 32, 4, 16, 32, 32
+        len_set = (16, 32, 48, 64)
+        attn_impl = "lax"
+        ttft_budget = 4.0
+        sysp_len = 4 * page_size + 2
+        decode_block = 8
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    lens = rng.choice(len_set, n_req)
+    hi = max(len_set)
+    cache_dtype = jnp.bfloat16 if not on_tpu else None
+
+    reg = obs.MetricsRegistry()
+    tracer = obs.Tracer(capacity=65536)
+
+    def make_replica(i):
+        eng = serving.ServingEngine(
+            model, params, num_slots=slots, page_size=page_size,
+            max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
+            decode_block=decode_block, attn_impl=attn_impl,
+            cache_dtype=cache_dtype, registry=obs.MetricsRegistry(),
+            tracer=tracer, ttft_budget_s=ttft_budget)
+        return fleet.LocalReplica(eng, name=f"replica{i}")
+
+    # every replica fully warmed (decode + prefill buckets + migration
+    # page IO) BEFORE the detector arms: the whole bench below must
+    # stay at zero compiles — the fleet-wide fixed-shape invariant
+    replicas = [make_replica(i).warmup() for i in range(4)]
+    det = obs.RecompileDetector("router_bench", warmup=0, registry=reg)
+
+    def fresh_prompts():
+        # same length mix every leg, fresh content (no cross-leg
+        # prefix sharing skewing a scaling comparison)
+        return [rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+                for n in lens]
+
+    leg_tel = {"steps": 0, "dt": 0.0}
+
+    def burst(router, prompts, probes=0, probe_interval=3):
+        """Submit everything up front, run to idle; returns (results
+        by submission index, probe TTFTs). Records the leg's step
+        count + wall time into ``leg_tel`` for the run log."""
+        for rep in router.replicas:
+            rep.busy_s = 0.0
+        frids = [router.submit(p, cap) for p in prompts]
+        probe_ids = []
+        steps = 0
+        t0 = time.perf_counter()
+        while not router.idle():
+            router.step()
+            steps += 1
+            if len(probe_ids) < probes and steps % probe_interval == 0:
+                pr = rng.integers(1, cfg.vocab_size,
+                                  min(len_set)).astype(np.int32)
+                probe_ids.append(router.submit(pr, 8,
+                                               lane="interactive"))
+            if steps > 1_000_000:
+                raise RuntimeError("fleet burst did not converge")
+        leg_tel["steps"] = steps
+        leg_tel["dt"] = time.perf_counter() - t0
+        outs = [router.result(f) for f in frids]
+        ttfts = [router.request_stats(f)["ttft_s"] for f in probe_ids]
+        return outs, ttfts
+
+    # --- scaling legs: 1 / 2 / 4 replicas over the same burst shape
+    scaling = {}
+    for n in (1, 2, 4):
+        router = fleet.FleetRouter(replicas[:n], registry=reg,
+                                   tracer=tracer, seed=n)
+        outs, _ = burst(router, fresh_prompts())
+        assert all(o is not None and len(o) == cap for o in outs), \
+            "scaling leg lost requests"
+        busy = max(rep.busy_s for rep in replicas[:n])
+        scaling[str(n)] = round(n_req * cap / max(busy, 1e-9), 2)
+    scaling_2x = scaling["2"] / max(scaling["1"], 1e-9)
+    scaling_4x = scaling["4"] / max(scaling["1"], 1e-9)
+
+    # --- SLO probe leg: interactive probes against the 2-replica fleet
+    # under the single-engine-saturating burst
+    router2 = fleet.FleetRouter(replicas[:2], registry=reg,
+                                tracer=tracer, seed=7)
+    _, probe_ttfts = burst(router2, fresh_prompts(),
+                           probes=max(4, slots),
+                           probe_interval=2 if dryrun else 3)
+    interactive_p99 = float(np.percentile(probe_ttfts, 99))
+
+    # --- affinity leg: one publisher wave, then shared-prefix traffic;
+    # the router must keep followers on the publishing replica
+    shared_before = sum(int(r.engine._reg.counter(
+        "serving_prefix_shared_tokens_total").value())
+        for r in replicas[:2])
+    router_a = fleet.FleetRouter(replicas[:2], registry=reg,
+                                 tracer=tracer, seed=9)
+    sysp = rng.integers(1, cfg.vocab_size, sysp_len).astype(np.int32)
+    def shared_prompt():
+        return np.concatenate([sysp, rng.integers(
+            1, cfg.vocab_size, int(min(len_set))).astype(np.int32)])
+    router_a.submit(shared_prompt(), 8)
+    router_a.run_until_idle(max_steps=1_000_000)
+    for _ in range(n_req // 2):
+        router_a.submit(shared_prompt(), 8)
+    router_a.run_until_idle(max_steps=1_000_000)
+    shared_after = sum(int(r.engine._reg.counter(
+        "serving_prefix_shared_tokens_total").value())
+        for r in replicas[:2])
+    prefix_tokens_shared = shared_after - shared_before
+    affinity_routed = router_a.routed_affinity_total
+
+    # --- migration leg: same traffic twice on 2 replicas; the second
+    # run drains replica1 mid-decode (queued requests re-routed,
+    # in-flight slots live-migrated) — byte-identical greedy outputs
+    # required. Sized to ONE replica's slots so the survivor has free
+    # capacity to restore into (a drain into a saturated peer rightly
+    # aborts — that is the no-request-lost contract, not the bench).
+    mig_prompts = fresh_prompts()[:slots]
+    router_m = fleet.FleetRouter(replicas[:2], registry=reg,
+                                 tracer=tracer, seed=13)
+    ref_outs, _ = burst(router_m, mig_prompts)
+    router_m2 = fleet.FleetRouter(replicas[:2], registry=reg,
+                                  tracer=tracer, seed=13)
+    for rep in replicas[:2]:
+        rep.busy_s = 0.0
+    frids = [router_m2.submit(p, cap) for p in mig_prompts]
+    # step until replica1 holds a MID-decode request (some tokens out,
+    # more to go) so the drain exercises a genuine in-flight migration
+    eng1 = replicas[1].engine
+    for _ in range(1_000):
+        router_m2.step()
+        mid = [i for i in eng1.scheduler.decode_slots()
+               if 0 < len(eng1.scheduler.slots[i].generated) < cap]
+        if mid:
+            break
+    else:
+        raise RuntimeError("no mid-decode drain window found")
+    migrations = router_m2.drain_replica(replicas[1], remove=False)
+    while not router_m2.idle():
+        router_m2.step()
+    replicas[1].draining = False        # hand the replica back
+    mig_outs = [router_m2.result(f) for f in frids]
+    parity_ok = all(
+        m is not None and r is not None and np.array_equal(r, m)
+        for r, m in zip(ref_outs, mig_outs))
+
+    det.check()
+
+    # --- trace artifact: the cross-replica timeline (ISSUE acceptance:
+    # one trace shows a request crossing the fleet through a migration)
+    spans = tracer.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    for needed in ("router.route", "serving.request", "router.migrate"):
+        if needed not in by_name:
+            raise RuntimeError(f"trace self-check: no {needed!r} spans")
+    req_tids = {s.trace_id for s in by_name["serving.request"]}
+    crossing = [s for s in by_name["router.migrate"]
+                if s.trace_id in req_tids]
+    if not crossing:
+        raise RuntimeError("trace self-check: no migration trace joins "
+                           "router.migrate to its request spans")
+    chrome = tracer.to_chrome()
+    obs.chrome_trace_valid(chrome, require_events=len(crossing))
+    jpath = router_json_path(dryrun)
+    trace_path = (jpath[:-5] if jpath.endswith(".json") else jpath) \
+        + ".trace.json"
+    with open(trace_path, "w") as f:
+        json.dump(chrome, f)
+
+    result = {
+        "metric": "router_aggregate_tokens_per_sec",
+        "value": scaling["2"],
+        "unit": "tokens/s",
+        # 1.0 == the >=1.6x two-replica scaling target
+        "vs_baseline": round(scaling_2x / 1.6, 4),
+        "aggregate_tokens_per_sec": scaling["2"],
+        "replica_scaling": scaling,
+        "scaling_2x": round(scaling_2x, 4),
+        "scaling_4x": round(scaling_4x, 4),
+        "ttft_interactive_p99_s": round(interactive_p99, 6),
+        "ttft_budget_s": ttft_budget,
+        "ttft_slo_met": bool(interactive_p99 <= ttft_budget),
+        "migrations": int(migrations),
+        "migration_parity_ok": bool(parity_ok),
+        "affinity_routed": int(affinity_routed),
+        "balance_routed": int(router_a.routed_balance_total),
+        "prefix_tokens_shared": int(prefix_tokens_shared),
+        "recompiles_after_warmup": det.recompiles,
+        "num_requests": n_req,
+        "replica_slots": slots,
+        "decode_cap": cap,
+        "trace_json": trace_path,
+        "trace_spans": len(spans),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "dryrun": bool(dryrun),
+        "_telemetry": {"steps": leg_tel["steps"], "dt": leg_tel["dt"],
+                       "examples_per_step": slots,
+                       "tokens_per_step": n_req * cap
+                       / max(leg_tel["steps"], 1)},
+    }
+    missing = [k for k in ROUTER_SCHEMA if k not in result]
+    if missing:
+        raise RuntimeError(f"BENCH_ROUTER schema self-check failed: "
+                           f"missing {missing}")
+    if not parity_ok:
+        raise RuntimeError("migration parity broken: drained run's "
+                           "greedy outputs differ from the clean run")
+    if migrations < 1:
+        raise RuntimeError("drain migrated nothing — the migration leg "
+                           "is dead")
+    if result["recompiles_after_warmup"] != 0:
+        raise RuntimeError(
+            f"fleet recompiled {det.recompiles}x after warmup — the "
+            "fleet-wide fixed-shape invariant broke (scaling numbers "
+            "untrustworthy)")
+    import os
+    committed = {k: v for k, v in result.items() if k != "_telemetry"}
+    committed["trace_json"] = os.path.basename(trace_path)
+    with open(jpath, "w") as f:
+        json.dump(committed, f, indent=2)
+    result["bench_json"] = jpath
+    return result
+
+
 SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                   "decode_tokens_per_sec", "baseline_tokens_per_sec",
                   "speedup_vs_dense_loop", "end_to_end_tokens_per_sec",
@@ -1265,6 +1580,8 @@ _BENCHES = {
     "embedding_serving": (run_bench_embedding_serving,
                           "embedding_serving_examples_per_sec",
                           "examples/s"),
+    "router": (run_bench_router, "router_aggregate_tokens_per_sec",
+               "tokens/s"),
 }
 
 
@@ -1282,7 +1599,7 @@ def main():
         from paddle_tpu import observability as obs
         obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
-        if which in ("serving", "embedding_serving"):
+        if which in ("serving", "embedding_serving", "router"):
             # CI smoke: tiny sizes + schema self-check
             result = _BENCHES[which][0](dev,
                                         dryrun="--dryrun" in sys.argv)

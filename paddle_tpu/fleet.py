@@ -193,7 +193,12 @@ class ElasticCoordinator:
     respawn while ``max_restarts`` allows. Exits in ``preempt_rc``
     (default: ``resilience.EXIT_PREEMPTED``, the drained-and-snapshotted
     preemption code) respawn WITHOUT consuming the restart budget —
-    a preemption is the platform's doing, not the job's.
+    a preemption is the platform's doing, not the job's. Exits in
+    ``drain_rc`` (default: ``resilience.EXIT_DRAINED``, the serving
+    fleet's voluntary scale-in code) retire the rank as DONE — the
+    worker migrated its state away on purpose, so it is neither
+    respawned nor charged against the budget; ``drained_exits`` counts
+    them.
 
     ``gang=True`` (default): ANY failure kills every worker and respawns
     the whole gang at attempt+1 — required for SPMD jobs, where a
@@ -207,20 +212,26 @@ class ElasticCoordinator:
                  max_restarts: int = 2, poll_s: float = 0.2,
                  success_rc: tuple = (0,), gang: bool = True,
                  preempt_rc: Optional[tuple] = None,
+                 drain_rc: Optional[tuple] = None,
                  log_fn=print):
         if preempt_rc is None:
             from paddle_tpu.resilience.preempt import EXIT_PREEMPTED
             preempt_rc = (EXIT_PREEMPTED,)
+        if drain_rc is None:
+            from paddle_tpu.resilience.preempt import EXIT_DRAINED
+            drain_rc = (EXIT_DRAINED,)
         self.spawn_fn = spawn_fn
         self.num_workers = num_workers
         self.max_restarts = max_restarts
         self.poll_s = poll_s
         self.success_rc = tuple(success_rc)
         self.preempt_rc = tuple(preempt_rc)
+        self.drain_rc = tuple(drain_rc)
         self.gang = gang
         self.restarts = 0                      # gang restarts
         self.rank_restarts = [0] * num_workers
         self.preemption_restarts = 0           # budget-free respawns
+        self.drained_exits = 0                 # voluntary scale-in exits
         self._log = log_fn
 
     def _spawn_all(self, attempt):
@@ -233,6 +244,9 @@ class ElasticCoordinator:
 
         procs = self._spawn_all(0)
         done = [False] * self.num_workers
+        # ranks that exited via drain_rc: retired for good — a gang
+        # respawn must not resurrect them (their work migrated away)
+        drained = [False] * self.num_workers
         deadline = _time.monotonic() + timeout_s
         try:
             while not all(done):
@@ -240,15 +254,29 @@ class ElasticCoordinator:
                     self._log("[elastic] deadline exceeded")
                     return False
                 failed = None
+                # scan EVERY exited rank before acting on a failure: a
+                # drain/success exit in the same poll window must be
+                # recorded first, or the gang respawn below would
+                # resurrect a rank that already retired voluntarily
                 for r, p in enumerate(procs):
                     if done[r] or p.poll() is None:
                         continue
                     rc = p.returncode
                     if rc in self.success_rc:
                         done[r] = True
-                    else:
+                    elif rc in self.drain_rc:
+                        # voluntary scale-in: the rank migrated its
+                        # work away and exited on purpose — done, no
+                        # respawn, no budget consumed (gang included:
+                        # the fleet CHOSE fewer replicas)
+                        self.drained_exits += 1
+                        self._log(f"[elastic] rank {r} drained rc={rc}; "
+                                  "retired (no respawn, no restart "
+                                  "budget consumed)")
+                        done[r] = True
+                        drained[r] = True
+                    elif failed is None:
                         failed = (r, rc)
-                        break
                 if failed is None:
                     _time.sleep(self.poll_s)
                     continue
@@ -280,9 +308,14 @@ class ElasticCoordinator:
                             p.kill()
                     for p in procs:
                         p.wait()
-                    procs = self._spawn_all(
-                        self.restarts + self.preemption_restarts)
-                    done = [False] * self.num_workers
+                    # drained ranks stay retired across a gang respawn
+                    # (their state lives on the peers): keep their dead
+                    # proc handle and pre-mark them done
+                    attempt = self.restarts + self.preemption_restarts
+                    procs = [procs[i] if drained[i]
+                             else self.spawn_fn(i, attempt)
+                             for i in range(self.num_workers)]
+                    done = list(drained)
                 else:
                     if preempted:
                         self.preemption_restarts += 1

@@ -228,6 +228,44 @@ def make_serving_engine(model, params, **kwargs):
     return _serving.ServingEngine(model, params, **kwargs)
 
 
+def make_serving_fleet(model, params, *, num_replicas: int = 2,
+                       policy: str = "affinity", registry=None,
+                       tracer=None, warmup: bool = True,
+                       autoscaler=None, seed: int = 0,
+                       **engine_kwargs):
+    """Multi-replica serving front end — N continuous-batching
+    :func:`make_serving_engine` replicas behind one
+    :class:`paddle_tpu.serving.fleet.FleetRouter`: prefix-affinity
+    routing (shared-prompt traffic lands where its prefix pages are
+    already hot), power-of-two-choices load balancing over live
+    ``health()``, router-minted trace ids crossing into replica spans,
+    and (optionally, via ``autoscaler=``) burn-rate elastic scaling
+    with live request migration on drain. All replicas share ``model``
+    + ``params`` (weights are read-only) and the given tracer so the
+    fleet emits ONE timeline; each gets its own metrics registry plus
+    the shared ``registry`` for fleet-level series. ``engine_kwargs``
+    pass through to every :class:`~paddle_tpu.serving.ServingEngine`.
+    Returns the router; replicas are warmed (every bucket precompiled)
+    before it is handed back unless ``warmup=False``."""
+    from paddle_tpu import observability as _obs
+    from paddle_tpu import serving as _serving
+    from paddle_tpu.serving import fleet as _fleet
+    registry = registry or _obs.default()
+    tracer = tracer or _obs.tracing.default()
+    reps = []
+    for i in range(num_replicas):
+        eng = _serving.ServingEngine(
+            model, params, registry=_obs.MetricsRegistry(),
+            tracer=tracer, **engine_kwargs)
+        rep = _fleet.LocalReplica(eng, name=f"replica{i}")
+        if warmup:
+            rep.warmup()
+        reps.append(rep)
+    return _fleet.FleetRouter(reps, policy=policy, registry=registry,
+                              tracer=tracer, seed=seed,
+                              autoscaler=autoscaler)
+
+
 def make_embedding_serving_engine(store, model=None, params=None,
                                   **kwargs):
     """Online embedding-lookup serving front end — the sparse/recsys

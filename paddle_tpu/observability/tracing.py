@@ -234,19 +234,24 @@ class Tracer:
         return self._make(name, parent, attrs)
 
     def start_span(self, name: str, parent: Optional[Span] = None,
-                   **attrs):
+                   trace_id: Optional[int] = None, **attrs):
         """Manual span — NOT pushed on the thread stack; the caller owns
         its lifetime and must ``finish()`` it (request-lifecycle roots
-        that live across many engine steps, cross-thread children)."""
+        that live across many engine steps, cross-thread children).
+        ``trace_id`` adopts an externally minted trace id (a fleet
+        router's, a remote caller's) instead of starting a fresh trace —
+        the propagation hook that lets one timeline cross process
+        boundaries where no parent ``Span`` object can travel."""
         if not self.enabled:
             return NOOP_SPAN
-        return self._make(name, parent, attrs)
+        return self._make(name, parent, attrs, trace_id=trace_id)
 
     def record_span(self, name: str, start: Optional[float] = None,
                     end: Optional[float] = None,
                     duration_s: Optional[float] = None,
                     parent: Optional[Span] = None,
                     status: Optional[str] = None,
+                    trace_id: Optional[int] = None,
                     **attrs) -> Optional[Span]:
         """Record an already-measured interval as a completed span (the
         engine times its jitted calls anyway; this turns those stamps
@@ -259,16 +264,19 @@ class Tracer:
             end = self.now()
         if start is None:
             start = end - (duration_s or 0.0)
-        sp = self._make(name, parent, attrs, start=start)
+        sp = self._make(name, parent, attrs, start=start,
+                        trace_id=trace_id)
         sp.finish(status=status, end=end)
         return sp
 
-    def _make(self, name, parent, attrs, start=None) -> Span:
+    def _make(self, name, parent, attrs, start=None,
+              trace_id=None) -> Span:
         if parent is None:
             st = self._stack.spans
             parent = st[-1] if st else None
         if parent is None or parent.span_id == _NO_ID:
-            trace_id = next(self._trace_ids)
+            if trace_id is None:
+                trace_id = next(self._trace_ids)
             parent_id = _NO_ID
         else:
             trace_id = parent.trace_id

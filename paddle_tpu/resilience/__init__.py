@@ -32,14 +32,15 @@ thin facade over :class:`SnapshotEngine`).
 from paddle_tpu.resilience.faults import (FaultInjected, FlakyFS, HostDead,
                                           TornWriteFS, corrupt_file,
                                           simulate_preemption)
-from paddle_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionGuard
+from paddle_tpu.resilience.preempt import (EXIT_DRAINED, EXIT_PREEMPTED,
+                                           PreemptionGuard)
 from paddle_tpu.resilience.retry import (RetryPolicy, retry_call, retrying)
 from paddle_tpu.resilience.snapshot import (SnapshotCorruptionError,
                                             SnapshotEngine, SnapshotError,
                                             flatten_tree, unflatten_tree)
 
 __all__ = [
-    "EXIT_PREEMPTED", "FaultInjected", "FlakyFS", "HostDead",
+    "EXIT_DRAINED", "EXIT_PREEMPTED", "FaultInjected", "FlakyFS", "HostDead",
     "PreemptionGuard", "RetryPolicy", "SnapshotCorruptionError",
     "SnapshotEngine", "SnapshotError", "TornWriteFS", "corrupt_file",
     "flatten_tree", "retry_call", "retrying", "simulate_preemption",

@@ -33,6 +33,14 @@ from paddle_tpu import observability
 # distinct from every exit code the launcher/tests already use (0..9).
 EXIT_PREEMPTED = 83
 
+# Voluntary scale-in drain (the serving fleet's autoscaler shrinking the
+# fleet): the worker migrated its in-flight state to peers and exited on
+# purpose. Distinct from EXIT_PREEMPTED — a preempted worker WANTS a
+# respawn (the platform took its slice), a drained worker must NOT be
+# respawned (the fleet chose fewer replicas). ``fleet.ElasticCoordinator``
+# retires a drained rank as done, consuming no respawn budget.
+EXIT_DRAINED = 84
+
 
 class PreemptionGuard:
     """Flag-setting signal trap with an explicit drain protocol.

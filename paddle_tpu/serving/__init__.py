@@ -25,24 +25,31 @@ rebuild). Four parts:
    AND one batched chunked-prefill step with donated cache pages (zero
    steady-state recompiles, proven by a ``RecompileDetector``), prefill/
    decode interleaving under a token budget, wired into the
-   observability registry with split TTFT accounting.
+   observability registry with split TTFT accounting — plus slot-level
+   live-migration snapshot/restore (sha256-verified per-page shards).
+5. **Fleet** (`fleet/`): N engines behind one ``FleetRouter`` —
+   prefix-affinity routing over the published prefix index,
+   power-of-two-choices fallback, burn-rate elastic autoscaling, and
+   live request migration on drain.
 """
 
 from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
-                                            PageOverflowError)
+                                            PageOverflowError,
+                                            prompt_prefix_digests)
 from paddle_tpu.serving.decode_attention import (
     paged_prefill_attention, ragged_paged_decode_attention,
     ragged_paged_prefill_attention)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           LoadShedError, Reject, Request,
                                           SLOScheduler, SlotState)
-from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.engine import ServingEngine, SlotMigrationError
+from paddle_tpu.serving import fleet
 
 __all__ = [
     "PagedCacheConfig", "PagedKVCache", "PageOverflowError",
     "paged_prefill_attention", "ragged_paged_decode_attention",
-    "ragged_paged_prefill_attention",
+    "ragged_paged_prefill_attention", "prompt_prefix_digests",
     "ContinuousBatchingScheduler", "SLOScheduler", "LoadShedError",
     "Reject", "Request", "SlotState",
-    "ServingEngine",
+    "ServingEngine", "SlotMigrationError", "fleet",
 ]

@@ -69,6 +69,8 @@ histogram (``slo_burn_rate`` gauge + edge-triggered
 
 from __future__ import annotations
 
+import hashlib
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
@@ -80,7 +82,8 @@ import numpy as np
 from paddle_tpu.serving import decode_attention as DA
 from paddle_tpu.serving.paged_cache import PagedCacheConfig, PagedKVCache
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
-                                          Reject, SLOScheduler)
+                                          Reject, Request, SLOScheduler,
+                                          SlotState)
 
 # TTFT/queue-wait histograms need sub-second resolution around
 # interactive SLO budgets; the default span (100us..100s) is too coarse
@@ -91,6 +94,14 @@ from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
 _LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.35,
                     0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 5.0, 7.5, 10.0,
                     15.0, 30.0, 60.0)
+
+MIGRATION_FORMAT = "paddle_tpu.serving.slot-migration-v1"
+
+
+class SlotMigrationError(RuntimeError):
+    """A slot snapshot cannot be restored: corrupt shard (sha256
+    mismatch), incompatible cache geometry, or no free slot/pages on
+    the target engine."""
 
 
 class ServingEngine:
@@ -191,6 +202,11 @@ class ServingEngine:
                                     donate_argnums=(1,))
         self.copy_page_step = jax.jit(self._copy_page_impl,
                                       donate_argnums=(0,))
+        # migration page IO (fleet drain): src/dst are traced scalars,
+        # so ONE compile each covers every page ever moved
+        self.read_page_step = jax.jit(self._read_page_impl)
+        self.write_page_step = jax.jit(self._write_page_impl,
+                                       donate_argnums=(0,))
         # finished-request store for result(); pop-on-read + bounded, so
         # a server that only consumes step()'s return dict still cannot
         # grow host memory with the total requests ever served
@@ -203,6 +219,18 @@ class ServingEngine:
         # when warmup has not run yet)
         self.warmed_signatures: set = set()
         self.bucket_costs: Dict[tuple, object] = {}
+        # externally-minted trace ids (router propagation) so
+        # request_stats carries them even with tracing disabled
+        self._ext_trace: Dict[int, int] = {}
+        self.migrated_in_total = 0
+        self.migrated_out_total = 0
+        # health(): a fleet router polls from ITS thread while step()
+        # mutates the scheduler/cache books — the engine publishes a
+        # consistent snapshot at safe points and health() only ever
+        # reads that, under a lock (never the live books)
+        self._health_lock = threading.Lock()
+        self._health_snap: Dict[str, object] = {}
+        self._refresh_health()
 
     # -- request surface --------------------------------------------------
 
@@ -211,10 +239,15 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None, *, lane: str = "default",
-               ttft_deadline_s: Optional[float] = None) -> int:
+               ttft_deadline_s: Optional[float] = None,
+               trace_id: Optional[int] = None) -> int:
         """Enqueue a request; returns its rid. ``lane`` and
         ``ttft_deadline_s`` feed the SLO scheduler (ignored under
-        ``scheduler_policy="fifo"``). Raises
+        ``scheduler_policy="fifo"``). ``trace_id`` adopts an externally
+        minted trace id (the fleet router's) for the request's root
+        span instead of starting a fresh trace, and is carried through
+        ``request_stats`` even with tracing off — one Perfetto timeline
+        then shows the request crossing router and replica. Raises
         :class:`~paddle_tpu.serving.LoadShedError` (with a structured
         :class:`~paddle_tpu.serving.Reject`) when the scheduler sheds
         the request instead of queueing it."""
@@ -253,14 +286,17 @@ class ServingEngine:
                                 "prefill_chunks": 0.0,
                                 "decode_blocks": 0.0,
                                 "shared_tokens": 0.0}
+        if trace_id is not None:
+            self._ext_trace[rid] = int(trace_id)
         if self.tracer.enabled:
             root = self.tracer.start_span(
-                "serving.request", rid=rid, lane=lane,
+                "serving.request", trace_id=trace_id, rid=rid, lane=lane,
                 prompt_tokens=total - max_new_tokens,
                 max_new_tokens=max_new_tokens)
             root.add_event("submitted",
                            queue_depth=self.scheduler.queue_depth())
             self._req_spans[rid] = root
+        self._refresh_health()
         return rid
 
     def _sched_event(self, rid: int, name: str, **attrs):
@@ -293,14 +329,17 @@ class ServingEngine:
         pop-on-read, bounded like ``result``)."""
         return self._stats.pop(rid, None)
 
-    def health(self) -> Dict[str, object]:
-        """Structured live health (the ``/healthz`` payload): slot
-        occupancy, queue depth, page utilization, recompile count, and
-        the SLO monitor's burn/alert state when one is configured."""
+    def _refresh_health(self):
+        """Recompute the health snapshot from the live scheduler/cache
+        books. Called only from the engine's own thread at consistent
+        points (construction, submit, end of step, migration), so the
+        reads here never race the step loop; cross-thread readers get
+        the last published snapshot via :meth:`health`."""
         h: Dict[str, object] = {
             "slot_occupancy": self.scheduler.occupancy(),
             "queue_depth": self.scheduler.queue_depth(),
             "page_utilization": self.cache.utilization(),
+            "free_slots": len(self.scheduler.free_slots()),
             "recompiles": self.recompile_detector.recompiles,
             "requests_in_flight": len(self.scheduler.active_slots()),
             "steps": int(self._reg.counter(
@@ -308,7 +347,19 @@ class ServingEngine:
         }
         if self.slo_monitor is not None:
             h["slo"] = self.slo_monitor.status()
-        return h
+        with self._health_lock:
+            self._health_snap = h
+
+    def health(self) -> Dict[str, object]:
+        """Structured live health (the ``/healthz`` payload and the
+        fleet router's load signal): slot occupancy, queue depth, page
+        utilization, free slots, recompile count, and the SLO monitor's
+        burn/alert state when one is configured. Safe (and cheap) to
+        call from any thread WHILE ``step()`` runs: it returns the
+        engine's last published snapshot under a lock rather than
+        reading the scheduler's live queue/slot books mid-mutation."""
+        with self._health_lock:
+            return dict(self._health_snap)
 
     def start_exposition(self, port: int = 0, host: str = "127.0.0.1"):
         """Opt-in live exposition for THIS engine: starts a background
@@ -344,6 +395,7 @@ class ServingEngine:
                                   "requests load-shed instead of queued"
                                   ).inc(reason=rej.reason)
                 self._phase_acc.pop(req.rid, None)
+                self._ext_trace.pop(req.rid, None)
                 root = self._req_spans.pop(req.rid, None)
                 if root is not None:
                     root.add_event("shed", reason=rej.reason,
@@ -433,6 +485,7 @@ class ServingEngine:
 
         if self.slo_monitor is not None:
             self.slo_monitor.check()
+        self._refresh_health()
         return finished
 
     def generate_many(self, prompts: Sequence, max_new_tokens: int = 32,
@@ -478,8 +531,9 @@ class ServingEngine:
                 "shared_tokens": acc.get("shared_tokens", 0.0),
                 "tokens": float(len(st.generated)),
                 "trace_id": float(root.trace_id) if root is not None
-                else 0.0,
+                else float(self._ext_trace.pop(req.rid, 0)),
             }
+            self._ext_trace.pop(req.rid, None)
             if root is not None:
                 root.add_event("finished", tokens=len(st.generated))
                 root.set_attrs(
@@ -705,6 +759,10 @@ class ServingEngine:
             for sb in counts:
                 plan.append(("prefill", w, sb))
         plan.append(("copy_page",))
+        # migration page IO: scalar-indexed, so one signature each
+        # covers every page a fleet drain ever reads or writes
+        plan.append(("page_read",))
+        plan.append(("page_write",))
         return plan
 
     def reachable_signatures(self):
@@ -721,6 +779,8 @@ class ServingEngine:
         sigs = {("decode", w) for w in widths}
         sigs |= {("prefill", w, sb) for w in widths for sb in counts}
         sigs.add(("copy_page",))
+        sigs.add(("page_read",))
+        sigs.add(("page_write",))
         return sigs
 
     def warmup(self, cost_gauges: bool = True):
@@ -760,6 +820,15 @@ class ServingEngine:
                 if cost_gauges:
                     self._bucket_cost_gauges(sig, self.prefill_step, args)
                 _, self.cache.pages = self.prefill_step(*args)
+            elif sig[0] == "page_read":
+                np.asarray(self.read_page_step(
+                    self.cache.pages, jnp.asarray(0, jnp.int32)))
+            elif sig[0] == "page_write":
+                c = self.cache.config
+                blank = jnp.zeros((2, c.num_layers, c.page_size,
+                                   c.num_heads, c.head_dim), c.dtype)
+                self.cache.pages = self.write_page_step(
+                    self.cache.pages, jnp.asarray(0, jnp.int32), blank)
             else:
                 self.cache.pages = self.copy_page_step(
                     self.cache.pages, jnp.asarray(0, jnp.int32),
@@ -788,6 +857,213 @@ class ServingEngine:
             "serving_bucket_cost_peak_hbm_bytes",
             "static peak-HBM estimate per compiled bucket").set(
                 cost.peak_hbm_bytes, **labels)
+
+    # -- live migration (fleet drain) -------------------------------------
+
+    def snapshot_slot(self, slot: int) -> Dict[str, object]:
+        """Portable snapshot of one in-flight request: its full
+        ``Request``/``SlotState`` bookkeeping plus the slot's live KV
+        pages, each page carried as one sha256-digested shard (the
+        resilience manifest discipline as a live-migration transfer
+        format). The slot keeps running — snapshotting mutates nothing;
+        pair with :meth:`release_slot` to actually drain it. A pending
+        copy-on-write tail reads THROUGH to its source page (the dst
+        has not been copied yet), so the snapshot always carries the
+        logical KV content."""
+        st = self.scheduler.slots[slot]
+        if st is None:
+            raise SlotMigrationError(f"slot {slot} is empty")
+        req = st.request
+        cfgc = self.cache.config
+        length = int(self.cache.lengths[slot])
+        n_live = cfgc.pages_for(length) if length else 0
+        pids = [int(p) for p in self.cache.block_tables[slot, :n_live]]
+        pc = self.cache.pending_copy(slot)
+        if pc is not None:
+            src, dst = pc
+            pids = [src if p == dst else p for p in pids]
+        shards, manifest = [], []
+        for k, pid in enumerate(pids):
+            kv = np.asarray(self.read_page_step(
+                self.cache.pages, jnp.asarray(pid, jnp.int32)))
+            shards.append(kv)
+            manifest.append({
+                "index": k,
+                "sha256": hashlib.sha256(kv.tobytes()).hexdigest(),
+                "bytes": kv.nbytes,
+            })
+        root = self._req_spans.get(req.rid)
+        trace_id = (root.trace_id if root is not None
+                    else self._ext_trace.get(req.rid, 0))
+        acc = self._phase_acc.get(req.rid) or {}
+        return {
+            "format": MIGRATION_FORMAT,
+            "geometry": {"num_layers": cfgc.num_layers,
+                         "num_heads": cfgc.num_heads,
+                         "head_dim": cfgc.head_dim,
+                         "page_size": cfgc.page_size,
+                         "dtype": str(jnp.dtype(cfgc.dtype))},
+            "request": {"prompt": np.asarray(req.prompt, np.int32),
+                        "max_new_tokens": req.max_new_tokens,
+                        "eos_id": req.eos_id, "lane": req.lane,
+                        "ttft_deadline_s": req.ttft_deadline_s,
+                        "submitted_at": req.submitted_at},
+            "state": {"generated": list(st.generated),
+                      "prefilled": int(st.prefilled),
+                      "length": length,
+                      "admitted_at": st.admitted_at,
+                      "first_token_at": st.first_token_at,
+                      "phase_acc": dict(acc)},
+            "trace_id": int(trace_id),
+            "shards": shards,
+            "manifest": manifest,
+        }
+
+    def cancel_queued(self) -> List[Request]:
+        """Pop every queued (not yet admitted) request and close its
+        engine-side bookkeeping — the open root span finishes with
+        status ``requeued`` (the fleet drain path re-submits the
+        request on a peer, which starts a fresh span on the same
+        trace), and the phase/trace maps are cleaned so nothing leaks.
+        Returns the popped :class:`~paddle_tpu.serving.Request`s in
+        queue order."""
+        out: List[Request] = []
+        sched = self.scheduler
+        while sched.queue:
+            r = sched.queue.popleft()
+            self._phase_acc.pop(r.rid, None)
+            self._ext_trace.pop(r.rid, None)
+            root = self._req_spans.pop(r.rid, None)
+            if root is not None:
+                root.add_event("requeued")
+                root.finish(status="requeued")
+            out.append(r)
+        self._refresh_health()
+        return out
+
+    def release_slot(self, slot: int):
+        """Drop a migrated-out slot WITHOUT recording a result: free
+        its pages, close its trace span as ``migrated``, and return the
+        popped :class:`~paddle_tpu.serving.SlotState` (the drain path's
+        receipt). The request lives on wherever its snapshot was
+        restored."""
+        st = self.scheduler.slots[slot]
+        if st is None:
+            raise SlotMigrationError(f"slot {slot} is empty")
+        self.scheduler.slots[slot] = None
+        self.cache.free_slot(slot)
+        rid = st.request.rid
+        self._phase_acc.pop(rid, None)
+        self._ext_trace.pop(rid, None)
+        root = self._req_spans.pop(rid, None)
+        if root is not None:
+            root.add_event("migrated_out", slot=slot,
+                           tokens=len(st.generated))
+            root.finish(status="migrated")
+        self.migrated_out_total += 1
+        self._reg.counter("serving_migrated_out_total",
+                          "in-flight requests migrated away").inc()
+        self._refresh_health()
+        return st
+
+    def restore_slot(self, snap: Dict[str, object], *,
+                     parent_span=None) -> int:
+        """Restore a :meth:`snapshot_slot` snapshot into a free slot of
+        THIS engine and resume it exactly where it left off: every
+        shard is sha256-verified before any page lands (corrupt
+        transfers are refused, never decoded), pages are reserved
+        all-or-nothing (unshared — the restored slot owns and may write
+        every page), and decode continues from the carried token
+        stream, so greedy outputs are byte-identical to an unmigrated
+        run. Returns the request's NEW rid on this engine. The restored
+        root span adopts the snapshot's ``trace_id`` (under
+        ``parent_span`` when given), keeping one timeline across the
+        migration."""
+        if snap.get("format") != MIGRATION_FORMAT:
+            raise SlotMigrationError(
+                f"unknown snapshot format {snap.get('format')!r}")
+        cfgc = self.cache.config
+        geo = snap["geometry"]
+        mine = {"num_layers": cfgc.num_layers, "num_heads": cfgc.num_heads,
+                "head_dim": cfgc.head_dim, "page_size": cfgc.page_size,
+                "dtype": str(jnp.dtype(cfgc.dtype))}
+        if geo != mine:
+            raise SlotMigrationError(
+                f"cache geometry mismatch: snapshot {geo} != engine {mine}")
+        shards, manifest = snap["shards"], snap["manifest"]
+        if len(shards) != len(manifest):
+            raise SlotMigrationError(
+                f"{len(shards)} shards != {len(manifest)} manifest entries")
+        for kv, rec in zip(shards, manifest):
+            digest = hashlib.sha256(np.asarray(kv).tobytes()).hexdigest()
+            if digest != rec["sha256"]:
+                raise SlotMigrationError(
+                    f"shard {rec['index']} sha256 mismatch "
+                    f"({digest[:12]}… != {rec['sha256'][:12]}…) — "
+                    "refusing to restore a corrupt page")
+        free = self.scheduler.free_slots()
+        if not free:
+            raise SlotMigrationError("no free slot to restore into")
+        rq = snap["request"]
+        prompt = np.asarray(rq["prompt"], np.int32).reshape(-1)
+        total = int(prompt.shape[0]) + int(rq["max_new_tokens"])
+        # shard count must agree with the carried live length AND fit
+        # the reservation: an excess shard would index past the block
+        # table's reserved entries (fill value 0) and overwrite the
+        # null page other live requests gather from
+        length = int(snap["state"]["length"])
+        n_live = cfgc.pages_for(length) if length > 0 else 0
+        if length < 0 or length > total or len(shards) != n_live:
+            raise SlotMigrationError(
+                f"{len(shards)} shards for {length} live tokens of a "
+                f"{total}-token reservation — snapshot state "
+                "inconsistent, refusing to restore")
+        if not self.cache.can_reserve(total):
+            raise SlotMigrationError(
+                f"no page capacity for {total} tokens")
+        slot = free[0]
+        # prompt=None: never map shared pages — the restore WRITES the
+        # carried KV into every live page, so the slot must own them all
+        self.cache.reserve(slot, total)
+        stt = snap["state"]
+        for k, kv in enumerate(shards):
+            dst = int(self.cache.block_tables[slot, k])
+            self.cache.pages = self.write_page_step(
+                self.cache.pages, jnp.asarray(dst, jnp.int32),
+                jnp.asarray(kv))
+        self.cache.lengths[slot] = int(stt["length"])
+        rid = next(self.scheduler._ids)     # fresh local rid, no collision
+        req = Request(rid, prompt, int(rq["max_new_tokens"]),
+                      rq["eos_id"], submitted_at=rq["submitted_at"],
+                      lane=rq["lane"],
+                      ttft_deadline_s=rq["ttft_deadline_s"])
+        st = SlotState(req, generated=list(stt["generated"]),
+                       prefilled=int(stt["prefilled"]),
+                       admitted_at=stt["admitted_at"],
+                       first_token_at=stt["first_token_at"])
+        self.scheduler.slots[slot] = st
+        acc = {"prefill_s": 0.0, "decode_s": 0.0, "prefill_chunks": 0.0,
+               "decode_blocks": 0.0, "shared_tokens": 0.0}
+        acc.update(stt.get("phase_acc") or {})
+        self._phase_acc[rid] = acc
+        trace_id = int(snap.get("trace_id") or 0)
+        if trace_id:
+            self._ext_trace[rid] = trace_id
+        if self.tracer.enabled:
+            root = self.tracer.start_span(
+                "serving.request", parent=parent_span,
+                trace_id=trace_id or None, rid=rid, lane=req.lane,
+                migrated=True, prompt_tokens=int(prompt.shape[0]),
+                max_new_tokens=req.max_new_tokens)
+            root.add_event("migrated_in", slot=slot,
+                           tokens=len(st.generated),
+                           kv_tokens=int(stt["length"]))
+            self._req_spans[rid] = root
+        self.migrated_in_total += 1
+        self._reg.counter("serving_migrated_in_total",
+                          "in-flight requests migrated in").inc()
+        self._refresh_health()
+        return rid
 
     # -- jitted step bodies ----------------------------------------------
 
@@ -909,4 +1185,22 @@ class ServingEngine:
         out = []
         for kp, vp in pages:
             out.append((kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])))
+        return out
+
+    def _read_page_impl(self, pages, src):
+        """One page's K/V across every layer, stacked (2, L, page_size,
+        H, Dh) — the migration shard unit. ``src`` is a traced scalar:
+        one compile covers every page ever snapshotted."""
+        ks = jnp.stack([kp[src] for kp, _vp in pages])
+        vs = jnp.stack([vp[src] for _kp, vp in pages])
+        return jnp.stack([ks, vs])
+
+    def _write_page_impl(self, pages, dst, kv):
+        """Install one migration shard (the :meth:`_read_page_impl`
+        layout) into page ``dst`` of every layer; pages donated, dst a
+        traced scalar — one compile covers every restore."""
+        out = []
+        for i, (kp, vp) in enumerate(pages):
+            out.append((kp.at[dst].set(kv[0, i].astype(kp.dtype)),
+                        vp.at[dst].set(kv[1, i].astype(vp.dtype))))
         return out

@@ -1,0 +1,38 @@
+"""Multi-replica serving fleet: router, autoscaler, live migration.
+
+The first subsystem that treats :class:`~paddle_tpu.serving.
+ServingEngine` replicas as cattle (ROADMAP open item 2). Three parts:
+
+1. **Replica handles** (`replica.py`): :class:`ReplicaHandle` is the
+   transport interface (submit / step / health / prefix digests /
+   snapshot / restore); :class:`LocalReplica` implements it in-process
+   — synchronous stepping for deterministic CI, optional background
+   thread — so a process/HTTP transport can slot in later without the
+   router changing.
+2. **Router** (`router.py`): :class:`FleetRouter` places requests by
+   **prefix affinity** first (the prompt's page-aligned content-hash
+   digests vs each replica's published prefix index — shared-prompt
+   traffic lands where its pages are hot) with **power-of-two-choices**
+   over live ``health()`` as fallback; router-minted ``trace_id``
+   propagates into replica spans so one Perfetto timeline crosses the
+   fleet; :class:`FleetMonitor` folds per-replica metrics into
+   fleet-level gauges behind one exposition endpoint.
+3. **Autoscaler** (`autoscaler.py`): :class:`FleetAutoscaler` turns
+   sustained SLO burn (each replica's BurnRateMonitor) into scale-out
+   — new replicas fully ``warmup()``-precompiled before taking traffic
+   — and sustained idle into scale-in via **live migration**: queued
+   requests re-routed, in-flight slots snapshotted (sha256-verified
+   per-page shards), restored into peers, decode resumed
+   byte-identically.
+"""
+
+from paddle_tpu.serving.fleet.replica import LocalReplica, ReplicaHandle
+from paddle_tpu.serving.fleet.router import FleetMonitor, FleetRouter
+from paddle_tpu.serving.fleet.autoscaler import FleetAutoscaler
+from paddle_tpu.serving.engine import SlotMigrationError
+from paddle_tpu.serving.paged_cache import prompt_prefix_digests
+
+__all__ = [
+    "ReplicaHandle", "LocalReplica", "FleetRouter", "FleetMonitor",
+    "FleetAutoscaler", "SlotMigrationError", "prompt_prefix_digests",
+]

@@ -1,0 +1,182 @@
+"""Elastic autoscaling: the BurnRateMonitor becomes the scale signal.
+
+Each replica already runs a multi-window SLO burn-rate monitor over its
+TTFT histogram (PR 8); the autoscaler reads that burn straight out of
+``health()["slo"]`` and turns sustained deadline pressure into capacity:
+
+- **Scale out**: any replica's burn over ``scale_out_burn`` on BOTH
+  windows (the page-severity shape — a spike alone never scales) for
+  ``sustain_s`` seconds → ``spawn_replica()`` builds a fresh replica,
+  the autoscaler runs its full ``warmup()`` (every decode/prefill/
+  migration bucket precompiled — ``warmup_plan`` discipline) BEFORE
+  the router sees it, so a scale-out never injects compiles into the
+  serving path.
+- **Scale in**: the whole fleet idle-ish (occupancy under
+  ``idle_occupancy`` and no queue) for ``idle_s`` seconds with more
+  than ``min_replicas`` running → the least-loaded replica is drained
+  through :meth:`FleetRouter.drain_replica` — queued requests
+  re-routed, in-flight requests **live-migrated** (snapshot → verified
+  restore → resume), never killed.
+
+A ``cooldown_s`` gate after either action stops flapping, and an
+injected ``clock`` makes every threshold unit-testable without
+sleeping. Replicas that exit as OS processes on scale-in should use
+:data:`~paddle_tpu.resilience.preempt.EXIT_DRAINED` so
+``fleet.ElasticCoordinator`` retires them without burning respawn
+budget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class FleetAutoscaler:
+    """Burn-rate-driven elastic sizing for a :class:`FleetRouter`.
+
+    ``spawn_replica(index) -> ReplicaHandle`` builds (but need not
+    warm) a new replica; the autoscaler warms it before attaching.
+    ``tick()`` is called once per fleet step (the router does this
+    automatically when constructed with ``autoscaler=``); it returns
+    ``"scale_out"`` / ``"scale_in"`` / ``None`` for observability and
+    tests.
+    """
+
+    def __init__(self, spawn_replica: Callable[[int], object], *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 scale_out_burn: float = 6.0, sustain_s: float = 2.0,
+                 idle_occupancy: float = 0.1, idle_s: float = 5.0,
+                 cooldown_s: float = 5.0, registry=None,
+                 clock=time.monotonic):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas < min_replicas")
+        self.spawn_replica = spawn_replica
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.scale_out_burn = float(scale_out_burn)
+        self.sustain_s = float(sustain_s)
+        self.idle_occupancy = float(idle_occupancy)
+        self.idle_s = float(idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        from paddle_tpu import observability as obs
+        self._reg = registry or obs.default()
+        self.router = None
+        self._spawned = 0
+        self._hot_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._cooldown_until = float("-inf")
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.events: List[Dict] = []
+
+    def bind(self, router):
+        self.router = router
+        self._spawned = len(router.replicas)
+
+    # -- signal reads ------------------------------------------------------
+
+    def _pressure(self) -> float:
+        """Hottest replica's burn, counted only when BOTH windows
+        breach (the alerting shape — one latency spike never scales)."""
+        worst = 0.0
+        for rep in self.router.replicas:
+            slo = rep.health().get("slo") or {}
+            bf = float(slo.get("burn_fast", 0.0))
+            bs = float(slo.get("burn_slow", 0.0))
+            if bf >= self.scale_out_burn and bs >= self.scale_out_burn:
+                worst = max(worst, bf)
+        return worst
+
+    def _fleet_idle(self) -> bool:
+        h = self.router.health()
+        return (h["queue_depth_total"] == 0
+                and h["slot_occupancy_mean"] <= self.idle_occupancy)
+
+    # -- the periodic decision ---------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        if self.router is None:
+            raise RuntimeError("autoscaler not bound to a router")
+        now = self._clock()
+        if now < self._cooldown_until:
+            return None
+        n = len(self.router.replicas)
+        burn = self._pressure()
+        if burn > 0.0 and n < self.max_replicas:
+            self._idle_since = None
+            if self._hot_since is None:
+                self._hot_since = now
+            if now - self._hot_since >= self.sustain_s:
+                return self._scale_out(burn)
+            return None
+        self._hot_since = None
+        if n > self.min_replicas and self._fleet_idle():
+            if self._idle_since is None:
+                self._idle_since = now
+            if now - self._idle_since >= self.idle_s:
+                return self._scale_in()
+            return None
+        self._idle_since = None
+        return None
+
+    def _scale_out(self, burn: float) -> str:
+        rep = self.spawn_replica(self._spawned)
+        self._spawned += 1
+        rep.warmup()        # every bucket compiled BEFORE first traffic
+        self.router.add_replica(rep)
+        self.scale_outs += 1
+        self._hot_since = None
+        self._cooldown_until = self._clock() + self.cooldown_s
+        self._reg.counter("fleet_scale_out_total",
+                          "replicas added by the autoscaler").inc()
+        self.events.append({"action": "scale_out", "burn": burn,
+                            "replicas": len(self.router.replicas),
+                            "replica": rep.name})
+        if self.router.tracer.enabled:
+            self.router.tracer.record_span(
+                "fleet.scale_out", duration_s=0.0, burn=round(burn, 3),
+                replicas=len(self.router.replicas), replica=rep.name)
+        return "scale_out"
+
+    def _scale_in(self) -> Optional[str]:
+        from paddle_tpu.serving.engine import SlotMigrationError
+        victim = min(
+            (r for r in self.router.replicas
+             if not getattr(r, "draining", False)),
+            key=lambda r: float(
+                r.health().get("requests_in_flight", 0)))
+        try:
+            migrated = self.router.drain_replica(victim)
+        except SlotMigrationError:
+            # peers had no capacity for the victim's in-flight work —
+            # the drain restored everything back and lost nothing, but
+            # the fleet cannot shrink right now. Back off a cooldown
+            # instead of re-raising into the serve loop (which would
+            # retry-and-crash every step while the condition holds).
+            self._idle_since = None
+            self._cooldown_until = self._clock() + self.cooldown_s
+            self._reg.counter(
+                "fleet_scale_in_aborted_total",
+                "scale-in drains aborted for lack of peer capacity"
+            ).inc()
+            self.events.append({"action": "scale_in_aborted",
+                                "replica": victim.name,
+                                "replicas": len(self.router.replicas)})
+            return None
+        self.scale_ins += 1
+        self._idle_since = None
+        self._cooldown_until = self._clock() + self.cooldown_s
+        self._reg.counter("fleet_scale_in_total",
+                          "replicas drained by the autoscaler").inc()
+        self.events.append({"action": "scale_in", "migrated": migrated,
+                            "replicas": len(self.router.replicas),
+                            "replica": victim.name})
+        if self.router.tracer.enabled:
+            self.router.tracer.record_span(
+                "fleet.scale_in", duration_s=0.0, migrated=migrated,
+                replicas=len(self.router.replicas), replica=victim.name)
+        return "scale_in"

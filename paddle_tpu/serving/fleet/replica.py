@@ -1,0 +1,225 @@
+"""Replica handles: the engine-as-cattle interface the fleet fronts.
+
+The router never touches a :class:`~paddle_tpu.serving.ServingEngine`
+directly — it speaks :class:`ReplicaHandle`, a small surface (submit /
+step / health / prefix digests / snapshot / restore) that an
+in-process threaded replica implements today and a process- or
+HTTP-backed transport can implement later without the router changing.
+
+:class:`LocalReplica` is the CI transport: it owns one engine, steps it
+either synchronously (the router's deterministic drive mode — the
+migration byte-parity tests need reproducible interleavings) or on its
+own background thread (``start()``/``stop()``), and tracks per-replica
+busy time so the bench can compute the fleet's critical path as if
+every replica had its own accelerator.
+
+Draining a replica is **migration, not kill**: ``drain_queue()`` hands
+back the not-yet-admitted requests for resubmission elsewhere, and
+``snapshot_inflight()`` walks the active slots through
+``engine.snapshot_slot`` (sha256-per-page shard manifests — the
+resilience transfer discipline) so peers can
+``restore()`` them and resume decode byte-identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ReplicaHandle:
+    """Transport interface between router and replica. Every method is
+    host-side and cheap except ``step()`` (one engine iteration).
+    Implementations must make ``health()`` safe to call from the
+    router's thread while ``step()`` runs."""
+
+    name: str = "replica"
+    draining: bool = False
+
+    def page_size(self) -> int:
+        """KV page size — the router needs it to compute page-aligned
+        prefix digests with the replicas' own alignment."""
+        raise NotImplementedError
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None, *, lane: str = "default",
+               ttft_deadline_s: Optional[float] = None,
+               trace_id: Optional[int] = None) -> int:
+        raise NotImplementedError
+
+    def step(self) -> Dict[int, np.ndarray]:
+        raise NotImplementedError
+
+    def health(self) -> Dict[str, object]:
+        raise NotImplementedError
+
+    def prefix_digests(self) -> frozenset:
+        """Published full-page prefix digests this replica can map
+        copy-free (the router's cache-locality signal)."""
+        raise NotImplementedError
+
+    def can_accept(self, total_tokens: int) -> bool:
+        raise NotImplementedError
+
+    def idle(self) -> bool:
+        raise NotImplementedError
+
+    def result(self, rid: int) -> Optional[np.ndarray]:
+        raise NotImplementedError
+
+    def request_stats(self, rid: int) -> Optional[Dict[str, float]]:
+        raise NotImplementedError
+
+    def drain_queue(self) -> List[Tuple]:
+        """Pop every queued (not yet admitted) request; returns
+        ``(rid, prompt, max_new_tokens, eos_id, lane, ttft_deadline_s)``
+        tuples for the router to resubmit on peers."""
+        raise NotImplementedError
+
+    def snapshot_inflight(self) -> List[Tuple[int, Dict]]:
+        """Snapshot-and-release every active slot; returns
+        ``(old_rid, snapshot)`` pairs ready for a peer's ``restore``."""
+        raise NotImplementedError
+
+    def restore(self, snap: Dict, *, parent_span=None) -> int:
+        raise NotImplementedError
+
+    def warmup(self):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class LocalReplica(ReplicaHandle):
+    """In-process replica over one :class:`ServingEngine`.
+
+    Synchronous mode (default): the router calls :meth:`step` — fully
+    deterministic, the mode every parity test runs. Threaded mode:
+    :meth:`start` spawns a loop calling ``step()`` whenever work is
+    pending (idle-backoff otherwise); finished results accumulate in a
+    bounded engine-side store exactly as in synchronous mode, and
+    ``health()`` stays safe because the engine publishes snapshots.
+    """
+
+    def __init__(self, engine, name: str = "replica0"):
+        self.engine = engine
+        self.name = name
+        self.busy_s = 0.0           # wall time inside step() — the
+        self.steps = 0              # bench's per-accelerator cost model
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.draining = False
+        # serializes engine MUTATIONS (submit vs step vs migration)
+        # for threaded mode — a router-thread submit must not mutate
+        # the scheduler queue mid-iteration. health() stays lock-free:
+        # the engine publishes snapshots for exactly that reason.
+        self._lock = threading.RLock()
+
+    # -- request surface ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None, *, lane: str = "default",
+               ttft_deadline_s: Optional[float] = None,
+               trace_id: Optional[int] = None) -> int:
+        with self._lock:
+            return self.engine.submit(prompt, max_new_tokens, eos_id,
+                                      lane=lane,
+                                      ttft_deadline_s=ttft_deadline_s,
+                                      trace_id=trace_id)
+
+    def step(self) -> Dict[int, np.ndarray]:
+        t0 = time.monotonic()
+        with self._lock:
+            out = self.engine.step()
+        self.busy_s += time.monotonic() - t0
+        self.steps += 1
+        return out
+
+    def health(self) -> Dict[str, object]:
+        return self.engine.health()
+
+    def page_size(self) -> int:
+        return self.engine.cache.config.page_size
+
+    def prefix_digests(self) -> frozenset:
+        return self.engine.cache.published_digests()
+
+    def can_accept(self, total_tokens: int) -> bool:
+        return (not self.draining
+                and self.engine.cache.config.pages_for(total_tokens)
+                <= self.engine.cache.config.max_pages_per_slot)
+
+    def idle(self) -> bool:
+        return self.engine.scheduler.idle()
+
+    def result(self, rid: int) -> Optional[np.ndarray]:
+        return self.engine.result(rid)
+
+    def request_stats(self, rid: int) -> Optional[Dict[str, float]]:
+        return self.engine.request_stats(rid)
+
+    def warmup(self):
+        self.engine.warmup()
+        return self
+
+    # -- drain / migration -------------------------------------------------
+    def drain_queue(self) -> List[Tuple]:
+        with self._lock:
+            # engine-owned cancellation: spans finish as "requeued" and
+            # the per-request maps are cleaned — popping the scheduler
+            # queue raw would leak them for the life of the process
+            return [(r.rid, r.prompt, r.max_new_tokens, r.eos_id,
+                     r.lane, r.ttft_deadline_s)
+                    for r in self.engine.cancel_queued()]
+
+    def snapshot_inflight(self) -> List[Tuple[int, Dict]]:
+        with self._lock:
+            eng = self.engine
+            out = []
+            for slot in list(eng.scheduler.active_slots()):
+                rid = eng.scheduler.slots[slot].request.rid
+                out.append((rid, eng.snapshot_slot(slot)))
+                eng.release_slot(slot)
+            return out
+
+    def restore(self, snap: Dict, *, parent_span=None) -> int:
+        with self._lock:
+            return self.engine.restore_slot(snap, parent_span=parent_span)
+
+    # -- threaded mode -----------------------------------------------------
+    def start(self, idle_sleep_s: float = 0.001) -> "LocalReplica":
+        """Background step loop: steps whenever the engine has queued
+        or in-flight work, sleeps briefly otherwise. The router keeps
+        submitting from its own thread; ``health()`` polls stay safe
+        (engine-published snapshots)."""
+        if self._thread is not None:
+            raise RuntimeError(f"{self.name} already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if self.engine.scheduler.idle():
+                    time.sleep(idle_sleep_s)
+                    continue
+                self.step()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"fleet-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    def running(self) -> bool:
+        return self._thread is not None
+
+    def close(self):
+        self.stop()

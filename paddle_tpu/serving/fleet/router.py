@@ -1,0 +1,460 @@
+"""Fleet router: prefix-affinity placement over N serving replicas.
+
+One ServingEngine is a hard ceiling; the fleet fronts N of them behind
+a single ``submit``/``step`` surface. Placement is two-tier:
+
+1. **Prefix affinity.** The router hashes the prompt's page-aligned
+   prefix digests (:func:`~paddle_tpu.serving.paged_cache.
+   prompt_prefix_digests` — the SAME content-hash chain
+   ``publish_prefix`` commits to each replica's prefix index) and
+   counts how many leading pages each replica's advertised digest set
+   already holds. The best match wins: shared-system-prompt traffic
+   lands where its pages are hot and prefill is skipped, a locality
+   signal no generic load balancer has.
+2. **Power-of-two-choices.** No replica holds any prefix page (or
+   several tie): sample two replicas and take the less loaded by live
+   ``health()`` (queue depth + in-flight slots) — the classic
+   O(log log n)-imbalance balancer, fed by the snapshot-published
+   health the engines expose for exactly this cross-thread poll.
+
+Every request gets a router-minted ``trace_id`` that propagates into
+the replica's ``serving.request`` span (``router.route`` /
+``router.migrate`` spans carry the same id), so one Perfetto timeline
+shows the request crossing the fleet.
+
+Scale-in drains **migrate** instead of killing: queued requests are
+re-routed to peers; in-flight slots are snapshotted (sha256-verified
+per-page shards), restored into peers' free slots, and resume decode
+byte-identically — see :meth:`FleetRouter.drain_replica`.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving.engine import SlotMigrationError
+from paddle_tpu.serving.paged_cache import prompt_prefix_digests
+from paddle_tpu.serving.scheduler import LoadShedError
+
+
+class FleetRouter:
+    """Single front door over N :class:`ReplicaHandle` replicas.
+
+    ``submit()`` routes and returns a fleet-level rid; ``step()``
+    advances every replica one engine iteration (the synchronous CI
+    drive — threaded replicas instead run their own loops) and returns
+    ``{fleet_rid: generated tokens}`` for requests that finished.
+    ``policy``: ``"affinity"`` (prefix-affinity, power-of-two-choices
+    fallback — the default), ``"p2c"`` (balance only), or
+    ``"round_robin"`` (the baseline the routing tests beat).
+    """
+
+    def __init__(self, replicas: Sequence, *, policy: str = "affinity",
+                 registry=None, tracer=None, seed: int = 0,
+                 autoscaler=None):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        if policy not in ("affinity", "p2c", "round_robin"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self.replicas: List = list(replicas)
+        self.policy = policy
+        from paddle_tpu import observability as obs
+        self._reg = registry or obs.default()
+        self.tracer = tracer or obs.tracing.default()
+        self._rng = random.Random(seed)
+        self.autoscaler = autoscaler
+        if autoscaler is not None:
+            autoscaler.bind(self)
+        self._frids = iter(range(1, 1 << 62))
+        self._where: Dict[int, tuple] = {}     # frid -> (replica, lrid)
+        self._trace: Dict[int, int] = {}       # frid -> trace_id
+        self._rev: Dict[tuple, int] = {}       # (id(rep), lrid) -> frid
+        self._results: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._stats: "OrderedDict[int, Dict]" = OrderedDict()
+        self._results_cap = 1024
+        self._rr = 0                           # round-robin cursor
+        self.migrations_total = 0
+        self.routed_affinity_total = 0
+        self.routed_balance_total = 0
+
+    # -- placement ---------------------------------------------------------
+
+    def _load(self, rep) -> float:
+        h = rep.health()
+        return (float(h.get("queue_depth", 0))
+                + float(h.get("requests_in_flight", 0)))
+
+    def _candidates(self, exclude=None):
+        return [r for r in self.replicas
+                if not getattr(r, "draining", False) and r is not exclude]
+
+    def _pick_p2c(self, cands):
+        if len(cands) == 1:
+            return cands[0]
+        a, b = self._rng.sample(cands, 2)
+        return a if self._load(a) <= self._load(b) else b
+
+    def _route(self, prompt, exclude=None):
+        """(replica, affinity_pages) for this prompt."""
+        cands = self._candidates(exclude)
+        if not cands:
+            raise SlotMigrationError("no routable replica")
+        if self.policy == "round_robin":
+            rep = cands[self._rr % len(cands)]
+            self._rr += 1
+            return rep, 0
+        if self.policy == "affinity":
+            digests = prompt_prefix_digests(
+                prompt, cands[0].page_size())
+            if digests:
+                best, best_hits = None, 0
+                for r in cands:
+                    held = r.prefix_digests()
+                    hits = 0
+                    for d in digests:       # leading run only: pages
+                        if d not in held:   # map in order or not at all
+                            break
+                        hits += 1
+                    if hits > best_hits or (hits == best_hits and hits
+                                            and best is not None
+                                            and self._load(r)
+                                            < self._load(best)):
+                        best, best_hits = r, hits
+                if best is not None and best_hits > 0:
+                    self.routed_affinity_total += 1
+                    return best, best_hits
+        rep = self._pick_p2c(cands)
+        self.routed_balance_total += 1
+        return rep, 0
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None, *, lane: str = "default",
+               ttft_deadline_s: Optional[float] = None) -> int:
+        """Route and enqueue; returns the fleet rid. A replica that
+        load-sheds is retried on the remaining replicas in load order
+        before the shed propagates — one hot replica must not turn
+        away traffic the rest of the fleet could serve."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rep, hits = self._route(prompt)
+        span = None
+        if self.tracer.enabled:
+            span = self.tracer.start_span(
+                "router.route", lane=lane,
+                prompt_tokens=int(prompt.shape[0]))
+        trace_id = span.trace_id if span is not None else 0
+        tried = []
+        try:
+            while True:
+                try:
+                    lrid = rep.submit(
+                        prompt, max_new_tokens, eos_id, lane=lane,
+                        ttft_deadline_s=ttft_deadline_s,
+                        trace_id=trace_id or None)
+                    break
+                except LoadShedError:
+                    tried.append(rep)
+                    rest = [r for r in self._candidates()
+                            if r not in tried]
+                    if not rest:
+                        if span is not None:
+                            span.finish(status="shed")
+                        raise
+                    rest.sort(key=self._load)
+                    rep, hits = rest[0], 0
+        except Exception:
+            if span is not None and span.end is None:
+                span.finish(status="error")
+            raise
+        frid = next(self._frids)
+        self._where[frid] = (rep, lrid)
+        self._rev[(id(rep), lrid)] = frid
+        if trace_id:
+            self._trace[frid] = trace_id
+        if span is not None:
+            span.set_attrs(replica=rep.name, fleet_rid=frid,
+                           affinity_pages=hits,
+                           policy=("affinity" if hits
+                                   else ("round_robin"
+                                         if self.policy == "round_robin"
+                                         else "p2c")))
+            span.finish()
+        self._reg.counter("fleet_requests_total",
+                          "requests routed by the fleet router").inc(
+                              replica=rep.name)
+        if hits:
+            self._reg.counter(
+                "fleet_affinity_routed_total",
+                "requests placed by prefix affinity").inc()
+        return frid
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """One synchronous fleet iteration: every replica steps once;
+        finished requests come back under their fleet rids. Runs the
+        autoscaler's ``tick()`` when one is attached."""
+        finished: Dict[int, np.ndarray] = {}
+        for rep in list(self.replicas):
+            if rep.idle():
+                continue
+            for lrid, toks in rep.step().items():
+                finished.update(self._finish(rep, lrid, toks))
+        if self.autoscaler is not None:
+            self.autoscaler.tick()
+        return finished
+
+    def _finish(self, rep, lrid, toks) -> Dict[int, np.ndarray]:
+        frid = self._rev.pop((id(rep), lrid), None)
+        if frid is None:
+            return {}
+        self._where.pop(frid, None)
+        st = rep.request_stats(lrid)
+        if st is not None:
+            st["replica"] = rep.name
+            self._stats[frid] = st
+        rep.result(lrid)                      # drop the replica's copy
+        self._results[frid] = toks
+        while len(self._results) > self._results_cap:
+            self._results.popitem(last=False)
+        while len(self._stats) > self._results_cap:
+            self._stats.popitem(last=False)
+        self._trace.pop(frid, None)
+        return {frid: toks}
+
+    def run_until_idle(self, max_steps: Optional[int] = None
+                       ) -> Dict[int, np.ndarray]:
+        out: Dict[int, np.ndarray] = {}
+        steps = 0
+        while not self.idle():
+            out.update(self.step())
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(f"fleet not idle in {max_steps} steps")
+        return out
+
+    def idle(self) -> bool:
+        return all(r.idle() for r in self.replicas)
+
+    def result(self, frid: int) -> Optional[np.ndarray]:
+        return self._results.pop(frid, None)
+
+    def request_stats(self, frid: int) -> Optional[Dict]:
+        return self._stats.pop(frid, None)
+
+    def trace_id(self, frid: int) -> int:
+        return self._trace.get(frid, 0)
+
+    def health(self) -> Dict[str, object]:
+        """Fleet-level aggregation of every replica's health snapshot
+        (the fleet ``/healthz`` payload)."""
+        per = {r.name: r.health() for r in self.replicas}
+        occ = [float(h.get("slot_occupancy", 0.0)) for h in per.values()]
+        return {
+            "replicas": len(self.replicas),
+            "queue_depth_total": sum(int(h.get("queue_depth", 0))
+                                     for h in per.values()),
+            "requests_in_flight": sum(int(h.get("requests_in_flight", 0))
+                                      for h in per.values()),
+            "slot_occupancy_mean": (sum(occ) / len(occ)) if occ else 0.0,
+            "recompiles": sum(int(h.get("recompiles", 0))
+                              for h in per.values()),
+            "migrations_total": self.migrations_total,
+            "per_replica": per,
+        }
+
+    # -- elasticity --------------------------------------------------------
+
+    def add_replica(self, rep):
+        """Attach an already-warmed replica (the autoscaler precompiles
+        via ``warmup_plan`` BEFORE the replica takes traffic)."""
+        self.replicas.append(rep)
+        self._reg.gauge("fleet_replicas",
+                        "replicas serving traffic").set(
+                            len(self.replicas))
+
+    def drain_replica(self, rep, *, remove: bool = True) -> int:
+        """Live-drain one replica: stop admitting, re-route its queued
+        requests, migrate every in-flight slot to a peer (snapshot →
+        sha256-verified restore → resume decode), then detach it.
+        Returns the number of in-flight requests migrated. A snapshot
+        no peer can place is restored straight back into the source
+        and the drain aborts with :class:`SlotMigrationError` — drain
+        never loses a request."""
+        if rep not in self.replicas:
+            raise ValueError(f"{rep.name} is not in this fleet")
+        if len(self.replicas) < 2:
+            raise SlotMigrationError("cannot drain the last replica")
+        rep.draining = True
+        # queued (unadmitted) requests: plain re-route, KV not built
+        # yet. Every remaining peer is tried in load order before a
+        # shed counts (the first p2c-sampled target shedding is not a
+        # fleet-wide verdict); a request EVERY peer sheds is dropped
+        # with its fleet bookkeeping cleaned — the same outcome a
+        # direct submit to a saturated fleet would have had.
+        for (lrid, prompt, mnew, eos, lane, dl) in rep.drain_queue():
+            frid = self._rev.pop((id(rep), lrid), None)
+            trace_id = self._trace.get(frid, 0) if frid else 0
+            first, _hits = self._route(prompt, exclude=rep)
+            others = sorted((r for r in self._candidates(exclude=rep)
+                             if r is not first), key=self._load)
+            nrid, target = None, None
+            for peer in [first] + others:
+                try:
+                    nrid = peer.submit(prompt, mnew, eos, lane=lane,
+                                       ttft_deadline_s=dl,
+                                       trace_id=trace_id or None)
+                    target = peer
+                    break
+                except LoadShedError:
+                    continue
+            if nrid is None:
+                if frid is not None:
+                    self._where.pop(frid, None)
+                    self._trace.pop(frid, None)
+                self._reg.counter(
+                    "fleet_requeue_shed_total",
+                    "drain re-routes shed by every remaining replica"
+                ).inc()
+                if self.tracer.enabled:
+                    self.tracer.record_span(
+                        "router.requeue", duration_s=0.0, status="shed",
+                        trace_id=trace_id or None, src=rep.name)
+                continue
+            if frid is not None:
+                self._where[frid] = (target, nrid)
+                self._rev[(id(target), nrid)] = frid
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "router.requeue", duration_s=0.0,
+                    trace_id=trace_id or None, src=rep.name,
+                    dst=target.name)
+        migrated = 0
+        snaps = rep.snapshot_inflight()
+        for pos, (lrid, snap) in enumerate(snaps):
+            frid = self._rev.pop((id(rep), lrid), None)
+            span = None
+            if self.tracer.enabled:
+                span = self.tracer.start_span(
+                    "router.migrate",
+                    trace_id=int(snap.get("trace_id") or 0) or None,
+                    src=rep.name)
+            peers = sorted(self._candidates(exclude=rep),
+                           key=self._load)
+            nrid, target = None, None
+            for peer in peers:
+                try:
+                    nrid = peer.restore(snap, parent_span=span)
+                    target = peer
+                    break
+                except SlotMigrationError:
+                    continue
+            if nrid is None:
+                # nowhere to put it: give this one AND every remaining
+                # snapshot back (their slots were already released for
+                # the transfer), then abort — drain never loses a
+                # request
+                for bfrid, bsnap in [(frid, snap)] + [
+                        (self._rev.pop((id(rep), blrid), None), bsnap2)
+                        for (blrid, bsnap2) in snaps[pos + 1:]]:
+                    back = rep.restore(bsnap)
+                    if bfrid is not None:
+                        self._where[bfrid] = (rep, back)
+                        self._rev[(id(rep), back)] = bfrid
+                rep.draining = False
+                if span is not None:
+                    span.finish(status="aborted")
+                raise SlotMigrationError(
+                    "no peer capacity for in-flight request; "
+                    "drain aborted")
+            if frid is not None:
+                self._where[frid] = (target, nrid)
+                self._rev[(id(target), nrid)] = frid
+            migrated += 1
+            self.migrations_total += 1
+            self._reg.counter(
+                "fleet_migrations_total",
+                "in-flight requests live-migrated between replicas"
+            ).inc()
+            if span is not None:
+                span.set_attrs(dst=target.name,
+                               kv_tokens=int(snap["state"]["length"]))
+                span.finish()
+        if remove:
+            self.replicas.remove(rep)
+            rep.close()
+            self._reg.gauge("fleet_replicas",
+                            "replicas serving traffic").set(
+                                len(self.replicas))
+        return migrated
+
+
+class FleetMonitor:
+    """Aggregates per-replica health into fleet-level gauges in ONE
+    registry, served from one exposition endpoint: ``collect()`` after
+    each fleet step (or on a poll thread) refreshes
+    ``fleet_replicas`` / ``fleet_queue_depth`` /
+    ``fleet_requests_in_flight`` / ``fleet_slot_occupancy`` (mean and
+    max) / ``fleet_page_utilization`` plus per-replica labeled series,
+    and :meth:`start_exposition` exposes them with the router's
+    aggregated ``/healthz``."""
+
+    def __init__(self, router: FleetRouter, registry=None):
+        from paddle_tpu import observability as obs
+        self.router = router
+        self.reg = registry or router._reg
+        self.tracer = router.tracer
+        self._obs = obs
+
+    def collect(self) -> Dict[str, object]:
+        h = self.router.health()
+        g = self.reg.gauge
+        g("fleet_replicas", "replicas serving traffic").set(
+            h["replicas"])
+        g("fleet_queue_depth", "queued requests across the fleet").set(
+            h["queue_depth_total"])
+        g("fleet_requests_in_flight",
+          "admitted requests across the fleet").set(
+              h["requests_in_flight"])
+        occ, util, burn = [], [], []
+        for name, rh in h["per_replica"].items():
+            occ.append(float(rh.get("slot_occupancy", 0.0)))
+            util.append(float(rh.get("page_utilization", 0.0)))
+            g("fleet_replica_queue_depth",
+              "per-replica queued requests").set(
+                  rh.get("queue_depth", 0), replica=name)
+            g("fleet_replica_slot_occupancy",
+              "per-replica decode-slot occupancy").set(
+                  rh.get("slot_occupancy", 0.0), replica=name)
+            slo = rh.get("slo")
+            if slo:
+                burn.append(float(slo.get("burn_fast", 0.0)))
+                g("fleet_replica_burn_rate",
+                  "per-replica fast-window SLO burn").set(
+                      slo.get("burn_fast", 0.0), replica=name)
+        if occ:
+            g("fleet_slot_occupancy_mean",
+              "mean decode-slot occupancy").set(sum(occ) / len(occ))
+            g("fleet_slot_occupancy_max",
+              "max decode-slot occupancy").set(max(occ))
+        if util:
+            g("fleet_page_utilization_mean",
+              "mean page-pool utilization").set(sum(util) / len(util))
+        if burn:
+            g("fleet_burn_rate_max",
+              "hottest replica's fast-window burn").set(max(burn))
+        return h
+
+    def start_exposition(self, port: int = 0, host: str = "127.0.0.1"):
+        """One live endpoint for the whole fleet: ``/metrics`` serves
+        the aggregated registry, ``/healthz`` the router's fleet
+        summary, ``/traces`` the shared tracer's ring (router spans and
+        every replica's request spans — one timeline)."""
+        srv = self._obs.ExpositionServer(registry=self.reg,
+                                         tracer=self.tracer,
+                                         port=port, host=host)
+        srv.add_health("fleet", lambda: self.collect())
+        return srv.start()

@@ -91,6 +91,40 @@ def _chain(parent_key: int, chunk: np.ndarray) -> int:
     return hash((parent_key, chunk.tobytes()))
 
 
+def _chain_walk(prompt, page_size: int, upto: int,
+                key: int = _ROOT_KEY, start_page: int = 0):
+    """Yield ``(page_index, chain_key, chunk)`` for each FULL page of
+    ``prompt[:upto]`` starting at ``start_page``, chaining from
+    ``key``. The ONE page-chain loop behind prefix matching, prefix
+    publication, AND the router's :func:`prompt_prefix_digests` — the
+    three must agree bit-for-bit or affinity prediction silently
+    diverges from what ``publish_prefix`` commits."""
+    k = key
+    p = start_page
+    while (p + 1) * page_size <= upto:
+        chunk = np.asarray(prompt[p * page_size:(p + 1) * page_size],
+                           np.int32)
+        k = _chain(k, chunk)
+        yield p, k, chunk
+        p += 1
+
+
+def prompt_prefix_digests(prompt, page_size: int) -> List[int]:
+    """The hash-chain keys of ``prompt``'s page-aligned full prefix
+    pages — digest ``k`` covers tokens ``[0, (k+1)*page_size)``. These
+    are EXACTLY the keys :meth:`PagedKVCache.publish_prefix` commits to
+    the full-page index, so intersecting them with a cache's
+    :meth:`~PagedKVCache.published_digests` predicts how many prefix
+    pages a new request would map instead of prefill — the fleet
+    router's cache-locality signal. Capped at ``len(prompt) - 1``
+    tokens, mirroring the at-least-one-token-prefills rule. In-process
+    only (python ``hash`` is seed-randomized per interpreter); a
+    cross-process transport must re-digest with a stable hash."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    limit = int(prompt.shape[0]) - 1
+    return [key for _p, key, _c in _chain_walk(prompt, page_size, limit)]
+
+
 class PagedKVCache:
     """Device pages + host-side page allocator, block tables, and the
     refcounted prefix-sharing index."""
@@ -130,6 +164,10 @@ class PagedKVCache:
         self._index_gen = 0
         self._match_cache: "OrderedDict[Tuple[int, int], tuple]" = \
             OrderedDict()
+        # published_digests() memo: the router reads it per candidate
+        # per submit; rebuild only when the index actually changed
+        self._digests = frozenset()
+        self._digests_gen = -1
         self.shared_tokens_total = 0     # prefill tokens skipped via sharing
         self.cow_copies_total = 0
 
@@ -215,15 +253,13 @@ class PagedKVCache:
         ps = self.config.page_size
         limit = int(prompt.shape[0]) - 1
         key, k, full = _ROOT_KEY, 0, []
-        while (k + 1) * ps <= limit:
-            chunk = np.asarray(prompt[k * ps:(k + 1) * ps], np.int32)
-            key2 = _chain(key, chunk)
+        for p, key2, chunk in _chain_walk(prompt, ps, limit):
             pid = self._full_index.get(key2)
             if pid is None or not np.array_equal(
                     self._page_tokens[pid], chunk):
                 break
             full.append(pid)
-            key, k = key2, k + 1
+            key, k = key2, p + 1
         shared = k * ps
         tail_pid = self._tail_index.get(key)
         if tail_pid is not None:
@@ -338,17 +374,16 @@ class PagedKVCache:
         # published (or borrowed) and their chain key is saved
         key = self._pub_chain[slot]
         k = self._published_upto[slot] // ps
-        while (k + 1) * ps <= upto:
-            chunk = np.asarray(prompt[k * ps:(k + 1) * ps], np.int32)
-            key = _chain(key, chunk)
-            pid = self._slot_pages[slot][k]
-            if (key not in self._full_index and pid in self._owned[slot]
+        for p, key2, chunk in _chain_walk(prompt, ps, upto,
+                                          key=key, start_page=k):
+            pid = self._slot_pages[slot][p]
+            if (key2 not in self._full_index and pid in self._owned[slot]
                     and pid not in self._page_pub):
-                self._full_index[key] = pid
-                self._page_pub[pid] = ("full", key)
+                self._full_index[key2] = pid
+                self._page_pub[pid] = ("full", key2)
                 self._page_tokens[pid] = chunk.copy()
                 self._index_gen += 1
-            k += 1
+            key, k = key2, p + 1
         self._pub_chain[slot] = key
         if upto >= int(prompt.shape[0]) and upto % ps:
             tail = np.asarray(prompt[k * ps:upto], np.int32)
@@ -384,6 +419,19 @@ class PagedKVCache:
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
+
+    def published_digests(self) -> frozenset:
+        """The full-page prefix digests currently resolvable through the
+        index (live or parked in the cached pool) — the set a replica
+        advertises to the fleet router; compare against
+        :func:`prompt_prefix_digests` of a candidate prompt. Memoized
+        on ``_index_gen`` (the same discipline as ``_match_prefix``):
+        the router polls this on every submit, the index changes only
+        on publish/unpublish."""
+        if self._digests_gen != self._index_gen:
+            self._digests = frozenset(self._full_index)
+            self._digests_gen = self._index_gen
+        return self._digests
 
     # -- device views -----------------------------------------------------
 

@@ -58,6 +58,7 @@ def iter_api():
         "paddle_tpu.observability": pt.observability,
         "paddle_tpu.resilience": pt.resilience,
         "paddle_tpu.serving": pt.serving,
+        "paddle_tpu.serving.fleet": pt.serving.fleet,
         "paddle_tpu.embedding_serving": pt.embedding_serving,
         "paddle_tpu.profiler": pt.profiler,
         "paddle_tpu.debug": pt.debug,

@@ -73,6 +73,45 @@ assert r["trace_spans"] > 0, "empty trace ring"
 print(f"serving dryrun prefill+SLO+trace metrics OK ({n} trace events)")
 '
 
+# router bench smoke: the multi-replica fleet (prefix-affinity router,
+# live migration, burn-rate autoscaling signal) must run end-to-end on
+# CPU and self-validate the BENCH_ROUTER schema — aggregate throughput
+# scales across 1/2/4 replicas, a mid-decode drain migrates in-flight
+# requests with byte-identical greedy outputs, zero recompiles
+# fleet-wide, and the trace artifact shows one request crossing the
+# fleet (router.route / serving.request / router.migrate share ids)
+echo "== bench smoke (router dryrun) =="
+ROUTER_OUT="$(python bench.py --model router --dryrun)"
+if echo "$ROUTER_OUT" | grep -q '"error"'; then
+  echo "router bench dryrun failed: $ROUTER_OUT"
+  exit 1
+fi
+echo "$ROUTER_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("replica_scaling", "scaling_2x", "scaling_4x",
+          "ttft_interactive_p99_s", "ttft_slo_met", "migrations",
+          "migration_parity_ok", "affinity_routed",
+          "prefix_tokens_shared", "recompiles_after_warmup",
+          "trace_json", "trace_spans"):
+    assert k in r, f"BENCH_ROUTER missing {k}"
+assert set(r["replica_scaling"]) == {"1", "2", "4"}
+assert r["migration_parity_ok"], "drained run diverged from clean run"
+assert r["migrations"] >= 1, "migration leg migrated nothing"
+assert r["recompiles_after_warmup"] == 0, "fleet recompiled"
+assert r["affinity_routed"] >= 1, "prefix affinity never fired"
+assert r["prefix_tokens_shared"] > 0, "affinity saved no prefill"
+assert r["ttft_slo_met"], "interactive probe TTFT blew the budget"
+from paddle_tpu.observability import tracing
+trace = json.load(open(r["trace_json"]))
+tracing.chrome_trace_valid(trace, require_events=1)
+names = {e["name"] for e in trace["traceEvents"]}
+for needed in ("router.route", "serving.request", "router.migrate",
+               "migrated_in", "migrated_out"):
+    assert needed in names, f"router trace missing {needed!r}"
+print("router dryrun fleet metrics OK")
+'
+
 # embedding-serving bench smoke: the device-cached host-KV lookup engine
 # must run end-to-end on CPU (cache hits/misses/evictions, streaming
 # pushes, zero steady-state recompiles) and self-validate the
