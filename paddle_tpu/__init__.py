@@ -9,10 +9,10 @@ runtime services (data feeding, inference serving) are native C++.
 from paddle_tpu.version import __version__
 
 from paddle_tpu import (amp, analysis, config, core, data, debug,
-                        embedding_serving, fleet, inference, io, metrics,
-                        models, nn, observability, ops, optimizer,
-                        parallel, profiler, resilience, serving, train,
-                        trainer)
+                        embedding_serving, fleet, inference, io, kernels,
+                        metrics, models, nn, observability, ops,
+                        optimizer, parallel, profiler, resilience,
+                        serving, train, trainer)
 from paddle_tpu.trainer import Trainer
 from paddle_tpu.config import global_config, set_flags
 from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
@@ -21,9 +21,9 @@ from paddle_tpu.train import build_eval_step, build_train_step, make_train_state
 
 __all__ = [
     "__version__", "amp", "analysis", "config", "core", "data", "debug",
-    "embedding_serving", "fleet", "inference", "io", "metrics", "models",
-    "nn", "observability", "ops", "optimizer", "parallel", "profiler",
-    "resilience", "serving", "train", "trainer", "Trainer",
+    "embedding_serving", "fleet", "inference", "io", "kernels", "metrics",
+    "models", "nn", "observability", "ops", "optimizer", "parallel",
+    "profiler", "resilience", "serving", "train", "trainer", "Trainer",
     "global_config", "set_flags", "MeshConfig", "make_mesh", "mesh_context",
     "CompiledProgram", "Executor", "Program",
     "build_eval_step", "build_train_step", "make_train_state",

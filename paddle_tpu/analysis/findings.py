@@ -81,6 +81,18 @@ RULES = {
         "a static cost metric (flops / peak-HBM / collective bytes) "
         "regressed beyond tolerance vs the committed baseline "
         "(tools/cost_budgets.json)"),
+    "kernel-contract": (
+        "error",
+        "a registered kernel's declared contract (layouts, donation-"
+        "safety, block candidates, zero-collective lowering) disagrees "
+        "with what the lowered HLO actually does "
+        "(paddle_tpu/kernels/lint.py)"),
+    "kernel-registry-bypass": (
+        "error",
+        "a pallas_call in ops/, parallel/ or serving/ belongs to no "
+        "registered kernel (and is not allowlisted in tools/"
+        "kernel_registry_allowlist.txt): bespoke kernels bypass the "
+        "shared autotuner, fallback harness, and parity battery"),
 }
 
 

@@ -1,0 +1,37 @@
+"""paddle_tpu.kernels — the shared Pallas kernel layer.
+
+One registry, one autotuner, one interpret/fallback harness for every
+Pallas kernel in the framework (flash attention, ring attention, ragged
+paged decode, ragged paged prefill — and every variant ROADMAP items 1
+and 3 add on top). See the submodule docstrings:
+
+- :mod:`~paddle_tpu.kernels.registry` — kernel contracts + registration
+- :mod:`~paddle_tpu.kernels.harness`  — ``dispatch()`` + parity battery
+- :mod:`~paddle_tpu.kernels.autotune` — block-size tuner, persisted to
+  the committed ``tools/kernel_tune.json``
+- :mod:`~paddle_tpu.kernels.lint`     — contract-vs-HLO verification and
+  the pallas_call bypass scan (``tools/graph_lint.py`` preset surface)
+
+Kernels register from their home modules at import time;
+:func:`load_all` imports them all so tools/tests can iterate the
+registry.
+"""
+
+from paddle_tpu.kernels.autotune import (DEFAULT_CACHE_PATH, KernelTuner,
+                                         default_tuner, seed_entry,
+                                         set_default_tuner, static_prior,
+                                         tune_key)
+from paddle_tpu.kernels.harness import (IMPLS, dispatch, on_tpu,
+                                        parity_check, resolve_impl)
+from paddle_tpu.kernels.lint import bypass_findings, lint_registry
+from paddle_tpu.kernels.registry import (KernelContract, KernelSpec,
+                                         all_pallas_sites, get, load_all,
+                                         names, register)
+
+__all__ = [
+    "DEFAULT_CACHE_PATH", "IMPLS", "KernelContract", "KernelSpec",
+    "KernelTuner", "all_pallas_sites", "bypass_findings",
+    "default_tuner", "dispatch", "get", "lint_registry", "load_all",
+    "names", "on_tpu", "parity_check", "register", "resolve_impl",
+    "seed_entry", "set_default_tuner", "static_prior", "tune_key",
+]

@@ -78,6 +78,71 @@ def make_padding_bias(pad_mask, dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
+# lax fallback with flash-kernel semantics (the shared-harness fallback)
+# ---------------------------------------------------------------------------
+
+def _masked_scores(q, k, bias, *, scale, causal):
+    """fp32 score block with the SAME masking the Pallas kernel applies."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
+    return s
+
+
+def _lax_flash_fwd(q, k, v, bias=None, *, scale=None, causal=False,
+                   return_lse=False):
+    """XLA-composed forward with the flash kernel's exact conventions:
+    fully-masked rows emit 0 (not a uniform mean of v) and, with
+    ``return_lse``, a ~NEG_INF logsumexp — so ring attention's
+    streaming logaddexp merge works identically on the fallback path.
+    This is the registered lax fallback of the ``flash_attention``
+    kernel (:mod:`paddle_tpu.kernels`)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None and bias.ndim < 4:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    s = _masked_scores(q, k, bias, scale=scale, causal=causal)
+    m = jnp.max(s, axis=-1)                         # (B,H,Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    denom = jnp.where(l == 0.0, 1.0, l)
+    alive = m > NEG_INF / 2
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = jnp.where(alive[..., None], out / denom[..., None], 0.0)
+    out = out.astype(q.dtype)
+    if return_lse:
+        return out, m + jnp.log(denom)              # dead rows: ~NEG_INF
+    return out
+
+
+def _lax_flash_block_bwd(q, k, v, bias, out, lse, g, *, scale, causal):
+    """XLA-composed FlashAttention-2 block backward against a GLOBAL
+    logsumexp: recompute p = exp(s - lse), then ds = p(dp - delta)scale.
+    Mirrors :func:`_flash_bwd`'s two Pallas kernels, so ring attention's
+    backward merge is backend-independent (grads accumulate across ring
+    blocks against the merged forward's lse on either path)."""
+    s = _masked_scores(q, k, bias, scale=scale, causal=causal)
+    p = jnp.exp(s - lse[..., None])
+    # fully-masked rows: lse ~ NEG_INF would turn exp into garbage ones
+    p = jnp.where(lse[..., None] <= NEG_INF / 2, 0.0, p)
+    g32 = g.astype(jnp.float32)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)   # (B,H,Sq)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Pallas flash-attention forward kernel
 # ---------------------------------------------------------------------------
 
@@ -606,10 +671,8 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # ---------------------------------------------------------------------------
 
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except RuntimeError:  # pragma: no cover
-        return False
+    from paddle_tpu.kernels import harness
+    return harness.on_tpu()
 
 
 def dot_product_attention(q, k, v, *, bias=None, causal=False,
@@ -618,7 +681,9 @@ def dot_product_attention(q, k, v, *, bias=None, causal=False,
     """Attention entry point used by nn layers.
 
     impl: "auto" (flash on TPU, xla elsewhere), "flash", "xla",
-    "flash_interpret" (tests).
+    "flash_interpret" (tests). The flash impls dispatch through the
+    shared kernel registry (:mod:`paddle_tpu.kernels`): block sizes
+    resolve from the autotuner cache at trace time.
     """
     if impl == "auto":
         impl = "flash" if (pltpu is not None and _on_tpu()
@@ -627,5 +692,81 @@ def dot_product_attention(q, k, v, *, bias=None, causal=False,
         return scaled_dot_product_attention(
             q, k, v, bias=bias, causal=causal, scale=scale,
             dropout_rate=dropout_rate, dropout_key=dropout_key)
-    interpret = impl == "flash_interpret"
-    return flash_attention(q, k, v, bias, causal, scale, 512, 512, interpret)
+    from paddle_tpu import kernels
+    return kernels.dispatch(
+        "flash_attention", q, k, v, bias,
+        impl="pallas_interpret" if impl == "flash_interpret" else "pallas",
+        causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entry (paddle_tpu.kernels)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel_pallas(q, k, v, bias=None, *, block_sizes, interpret,
+                         causal=False, scale=None):
+    return flash_attention(q, k, v, bias, causal, scale,
+                           block_sizes.get("block_q", 512),
+                           block_sizes.get("block_k", 512), interpret)
+
+
+def _flash_kernel_lax(q, k, v, bias=None, *, causal=False, scale=None):
+    return _lax_flash_fwd(q, k, v, bias, scale=scale, causal=causal)
+
+
+def _flash_kernel_reference(q, k, v, bias=None, *, causal=False,
+                            scale=None):
+    return scaled_dot_product_attention(q, k, v, bias=bias, causal=causal,
+                                        scale=scale)
+
+
+def _flash_sample_inputs(seed):
+    b, h, s, d = ((1, 2, 64, 32), (2, 2, 128, 64), (1, 4, 320, 64))[
+        seed % 3]
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return ((jax.random.normal(kq, (b, h, s, d), jnp.float32),
+             jax.random.normal(kk, (b, h, s, d), jnp.float32),
+             jax.random.normal(kv, (b, h, s, d), jnp.float32)),
+            {"causal": True})
+
+
+def _flash_tune_signature(args, kwargs):
+    q, k = args[0], args[1]
+    b, h, sq, d = q.shape
+    return (("bh", b * h), ("q", sq), ("k", k.shape[2]), ("d", d))
+
+
+def _flash_vmem_estimate(args, kwargs, blocks):
+    d = args[0].shape[-1]
+    bq = blocks.get("block_q", 512)
+    bk = blocks.get("block_k", 512)
+    # fp32 working set: q + acc, k + v, s + p, m/l lane scratch
+    return 4 * (2 * bq * d + 2 * bk * d + 2 * bq * bk + 2 * bq * 128)
+
+
+def _register_flash_kernel():
+    from paddle_tpu import kernels
+    kernels.register(kernels.KernelSpec(
+        name="flash_attention",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(B,H,Sq,D)", "k": "(B,H,Sk,D)",
+                         "v": "(B,H,Sk,D)",
+                         "bias": "(B,H,Sq,Sk) additive, optional"},
+            out_layout="(B,H,Sq,D)",
+            grid="(B*H, cdiv(Sq,block_q), cdiv(Sk,block_k)) "
+                 "kv-arbitrary online softmax",
+            block_candidates={"block_q": (512, 256, 128),
+                              "block_k": (512, 256, 128)},
+            atol=2e-5, rtol=2e-5),
+        pallas_fn=_flash_kernel_pallas,
+        lax_fn=_flash_kernel_lax,
+        reference_fn=_flash_kernel_reference,
+        sample_inputs=_flash_sample_inputs,
+        pallas_sites=("paddle_tpu.ops.attention:_flash_fwd",
+                      "paddle_tpu.ops.attention:_flash_bwd"),
+        tune_signature=_flash_tune_signature,
+        vmem_estimate=_flash_vmem_estimate))
+
+
+_register_flash_kernel()

@@ -123,21 +123,42 @@ def _ring_flash_case(idx, src, n):
 
 
 def _make_ring_flash(axis: str, scale: float, causal: bool,
-                     interpret: bool):
+                     interpret: bool, block_q: int = 512,
+                     block_k: int = 512):
     from paddle_tpu.ops import attention as A
+
+    # Interpret/single-device mode routes each ring block through the
+    # shared harness's lax fallback (paddle_tpu.kernels: the registered
+    # flash kernel's lax_fn + block backward) instead of running the
+    # Pallas kernel under the interpreter. Same numerics (the fallback
+    # mirrors the kernel's masking/lse conventions exactly), but the
+    # traced program contains no Pallas interpreter shim — which is what
+    # used to lower a PartitionId op XLA refuses under SPMD partitioning
+    # (the old strict-xfail in tests/test_ring_attention.py).
+    def fwd_one(q, k, v, bias, blk_causal):
+        if interpret:
+            return A._lax_flash_fwd(q, k, v, bias, scale=scale,
+                                    causal=blk_causal, return_lse=True)
+        return A._flash_fwd(q, k, v, bias, scale=scale, causal=blk_causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=False, return_lse=True)
+
+    def bwd_one(q, k, v, bias, out, lse, g, blk_causal):
+        if interpret:
+            return A._lax_flash_block_bwd(q, k, v, bias, out, lse, g,
+                                          scale=scale, causal=blk_causal)
+        return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
+                            causal=blk_causal, block_q=block_q,
+                            block_k=block_k, interpret=False)
 
     def fwd_block(q, k, v, bias, case):
         b, h, sl, d = q.shape
 
         def diag(q, k, v, bias):
-            return A._flash_fwd(q, k, v, bias, scale=scale, causal=True,
-                                block_q=512, block_k=512,
-                                interpret=interpret, return_lse=True)
+            return fwd_one(q, k, v, bias, True)
 
         def full(q, k, v, bias):
-            return A._flash_fwd(q, k, v, bias, scale=scale, causal=False,
-                                block_q=512, block_k=512,
-                                interpret=interpret, return_lse=True)
+            return fwd_one(q, k, v, bias, False)
 
         def skip(q, k, v, bias):
             return (jnp.zeros((b, h, sl, d), q.dtype),
@@ -149,14 +170,10 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
 
     def bwd_block(q, k, v, bias, out, lse, g, case):
         def diag(q, k, v, bias, out, lse, g):
-            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
-                                causal=True, block_q=512, block_k=512,
-                                interpret=interpret)
+            return bwd_one(q, k, v, bias, out, lse, g, True)
 
         def full(q, k, v, bias, out, lse, g):
-            return A._flash_bwd(q, k, v, bias, out, lse, g, scale=scale,
-                                causal=False, block_q=512, block_k=512,
-                                interpret=interpret)
+            return bwd_one(q, k, v, bias, out, lse, g, False)
 
         def skip(q, k, v, bias, out, lse, g):
             return (jnp.zeros_like(q), jnp.zeros_like(k),
@@ -178,7 +195,10 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
 
     def _ring_flash_fwd(q, k, v, bias):
         n = _axis_size(axis)
-        idx = jax.lax.axis_index(axis)
+        # axis_index only when the case matters: a dead PartitionId in
+        # the non-causal lowering is exactly what XLA's SPMD partitioner
+        # refuses ("PartitionId instruction is not supported...")
+        idx = jax.lax.axis_index(axis) if causal else 0
         b, h, sl, d = q.shape
         perm = [(i, (i + 1) % n) for i in range(n)]
         out = jnp.zeros((b, h, sl, d), jnp.float32)
@@ -186,9 +206,9 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
 
         def step(i, carry):
             out, lse, k, v, bias = carry
-            src = jax.lax.rem(idx - i + n, n)
-            o_blk, lse_blk = fwd_block(
-                q, k, v, bias, _ring_flash_case(idx, src, n))
+            case = (_ring_flash_case(idx, jax.lax.rem(idx - i + n, n), n)
+                    if causal else 0)
+            o_blk, lse_blk = fwd_block(q, k, v, bias, case)
             lse_new = jnp.logaddexp(lse, lse_blk)
             # guard fully-masked rows: both weights would be exp(NEG_INF -
             # NEG_INF-ish) garbage; forcing weights to 0 keeps out at 0
@@ -211,7 +231,7 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
     def vjp_bwd(res, g):
         q, k, v, bias, out, lse = res
         n = _axis_size(axis)
-        idx = jax.lax.axis_index(axis)
+        idx = jax.lax.axis_index(axis) if causal else 0  # see fwd note
         perm = [(i, (i + 1) % n) for i in range(n)]
         # fp32 accumulators: each ring step adds a partial; rounding to the
         # input dtype per step would degrade grads as sp grows (the
@@ -222,10 +242,10 @@ def _make_ring_flash(axis: str, scale: float, causal: bool,
 
         def step(i, carry):
             dq, k, v, bias, dk, dv = carry
-            src = jax.lax.rem(idx - i + n, n)
+            case = (_ring_flash_case(idx, jax.lax.rem(idx - i + n, n), n)
+                    if causal else 0)
             dq_blk, dk_blk, dv_blk = bwd_block(
-                q, k, v, bias, out, lse, g,
-                _ring_flash_case(idx, src, n))
+                q, k, v, bias, out, lse, g, case)
             dq = dq + dq_blk.astype(jnp.float32)
             dk = dk + dk_blk.astype(jnp.float32)
             dv = dv + dv_blk.astype(jnp.float32)
@@ -254,9 +274,13 @@ def ring_attention(q, k, v, *, bias=None, causal=False,
 
     ``impl``: "xla" (composed online-softmax blocks), "flash" (Pallas
     kernel per ring block — flash-level arithmetic intensity under sp>1),
-    "flash_interpret" (tests on CPU), "auto" (flash on TPU, xla elsewhere).
-    Must run under a mesh (pjit/jit with mesh context). Returns (B,H,S,D)
-    with the same sharding as q.
+    "flash_interpret" (CPU: the shared harness's lax fallback per ring
+    block — same numerics, no Pallas interpreter in the traced program),
+    "auto" (flash on TPU, xla elsewhere). Dispatches through the shared
+    kernel registry (:mod:`paddle_tpu.kernels`); the inner flash block
+    sizes resolve from the autotuner at trace time. Must run under a
+    mesh (pjit/jit with mesh context). Returns (B,H,S,D) with the same
+    sharding as q.
 
     ``bias`` is a CONSTANT mask: it is stop-gradiented on every impl (the
     flash kernels do not produce bias cotangents; stopping it on the xla
@@ -271,41 +295,134 @@ def ring_attention(q, k, v, *, bias=None, causal=False,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if bias is not None:
         bias = jax.lax.stop_gradient(bias)
-    if impl == "auto":
-        from paddle_tpu.ops.attention import _on_tpu, pltpu
-        impl = "flash" if (pltpu is not None and _on_tpu()) else "xla"
+    legacy = {"auto": "auto", "flash": "pallas",
+              "flash_interpret": "pallas_interpret", "xla": "lax"}
+    if impl not in legacy:
+        raise ValueError(f"unknown impl {impl!r} "
+                         f"(expected {'|'.join(legacy)})")
+    from paddle_tpu import kernels
+    return kernels.dispatch("ring_attention", q, k, v, bias,
+                            impl=legacy[impl], causal=causal, scale=scale,
+                            axis=axis, mesh=mesh)
 
+
+def _ring_shard_map(body, mesh, axis, with_bias, args):
     qkv_spec = P(mesh_lib.BATCH_AXES, mesh_lib.TP, axis, None)
     bias_spec = P(mesh_lib.BATCH_AXES, None, None, axis)
-    in_specs = (qkv_spec, qkv_spec, qkv_spec)
-    args = (q, k, v)
-
-    if impl in ("flash", "flash_interpret"):
-        local = _make_ring_flash(axis, scale, causal,
-                                 interpret=impl == "flash_interpret")
-        if bias is not None:
-            in_specs = in_specs + (bias_spec,)
-            args = args + (bias,)
-
-            def body(q, k, v, bias):
-                return local(q, k, v, bias)
-        else:
-            def body(q, k, v):
-                return local(q, k, v, None)
-    elif bias is not None:
-        in_specs = in_specs + (bias_spec,)
-        args = args + (bias,)
-
-        def body(q, k, v, bias):
-            return _ring_attention_local(q, k, v, bias, axis=axis,
-                                         scale=scale, causal=causal)
-    else:
-        def body(q, k, v):
-            return _ring_attention_local(q, k, v, None, axis=axis,
-                                         scale=scale, causal=causal)
-
+    in_specs = (qkv_spec,) * 3 + ((bias_spec,) if with_bias else ())
     from paddle_tpu.core.compat import shard_map
     return shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
         check_vma=False,
     )(*args)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entry (paddle_tpu.kernels)
+# ---------------------------------------------------------------------------
+
+def _ring_kernel_pallas(q, k, v, bias=None, *, block_sizes, interpret,
+                        causal=False, scale=None, axis=mesh_lib.SP,
+                        mesh=None):
+    local = _make_ring_flash(axis, scale, causal, interpret=interpret,
+                             block_q=block_sizes.get("block_q", 512),
+                             block_k=block_sizes.get("block_k", 512))
+    if bias is not None:
+        return _ring_shard_map(lambda q, k, v, b: local(q, k, v, b),
+                               mesh, axis, True, (q, k, v, bias))
+    return _ring_shard_map(lambda q, k, v: local(q, k, v, None),
+                           mesh, axis, False, (q, k, v))
+
+
+def _ring_kernel_lax(q, k, v, bias=None, *, causal=False, scale=None,
+                     axis=mesh_lib.SP, mesh=None):
+    if bias is not None:
+        return _ring_shard_map(
+            lambda q, k, v, b: _ring_attention_local(
+                q, k, v, b, axis=axis, scale=scale, causal=causal),
+            mesh, axis, True, (q, k, v, bias))
+    return _ring_shard_map(
+        lambda q, k, v: _ring_attention_local(
+            q, k, v, None, axis=axis, scale=scale, causal=causal),
+        mesh, axis, False, (q, k, v))
+
+
+def _ring_sample_inputs(seed):
+    b, h, s, d = ((2, 2, 32, 8), (2, 4, 64, 16), (2, 4, 128, 32))[seed % 3]
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return ((jax.random.normal(kq, (b, h, s, d), jnp.float32),
+             jax.random.normal(kk, (b, h, s, d), jnp.float32),
+             jax.random.normal(kv, (b, h, s, d), jnp.float32)),
+            {"causal": True})
+
+
+def _ring_tune_signature(args, kwargs):
+    q = args[0]
+    b, h, s, d = q.shape
+    return (("bh", b * h), ("s", s), ("d", d))
+
+
+def _ring_parity_fn(seed):
+    """Mesh-orchestrated battery: flash_interpret (shared-harness lax
+    fallback per ring block) and the composed xla impl vs the dense
+    full-attention reference, on an sp=2 mesh."""
+    import numpy as np
+    from paddle_tpu.core.mesh import MeshConfig, make_mesh, mesh_context
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    n = len(jax.devices())
+    # make_mesh needs ALL n devices; the b=2 samples need dp in {1, 2}
+    # and the 32..128-token seqs need a pow2 sp — pick the largest fit,
+    # and skip (not crash) on counts no such mesh covers (odd boxes)
+    dims = next(((dp, sp) for dp in (2, 1) for sp in (8, 4, 2)
+                 if dp * sp == n), None)  # prefer batch-sharded dp=2
+    if dims is None:
+        return {}                     # no ring-able mesh on this box
+    (q, k, v), kw = _ring_sample_inputs(seed)
+    ref = np.asarray(scaled_dot_product_attention(q, k, v, **kw),
+                     np.float32)
+    mesh = make_mesh(MeshConfig(dp=dims[0], sp=dims[1]))
+    from paddle_tpu import kernels
+    contract = kernels.get("ring_attention").contract
+    errs = {}
+    with mesh_context(mesh):
+        for impl in ("xla", "flash_interpret"):
+            out = np.asarray(jax.jit(
+                lambda q, k, v: ring_attention(q, k, v, mesh=mesh,
+                                               impl=impl, **kw))(q, k, v),
+                np.float32)
+            np.testing.assert_allclose(
+                out, ref, atol=contract.atol, rtol=contract.rtol,
+                err_msg=f"ring_attention[{impl}] diverged from the dense "
+                        "reference")
+            errs[impl] = float(np.max(np.abs(out - ref)))
+    return errs
+
+
+def _register_ring_kernel():
+    from paddle_tpu import kernels
+    kernels.register(kernels.KernelSpec(
+        name="ring_attention",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(B,H,S,D) S sharded over sp",
+                         "k": "(B,H,S,D) S sharded over sp",
+                         "v": "(B,H,S,D) S sharded over sp",
+                         "bias": "(B,1,1,S) key padding, optional"},
+            out_layout="(B,H,S,D) sharded like q",
+            grid="ring of sp ppermute hops; inner flash kernel per "
+                 "visiting block",
+            block_candidates={"block_q": (512, 256, 128),
+                              "block_k": (512, 256, 128)},
+            atol=2e-5, rtol=2e-5),
+        pallas_fn=_ring_kernel_pallas,
+        lax_fn=_ring_kernel_lax,
+        reference_fn=None,            # parity_fn orchestrates the mesh
+        sample_inputs=_ring_sample_inputs,
+        pallas_sites=(),              # reuses the flash kernel's sites
+        requires_mesh=True,
+        tune_signature=_ring_tune_signature,
+        vmem_estimate=None,
+        parity_fn=_ring_parity_fn))
+
+
+_register_ring_kernel()

@@ -27,12 +27,20 @@ Each has two implementations with identical numerics:
 
 - ``impl="lax"``: XLA gather + masked softmax (CPU/debug reference).
 - ``impl="pallas"`` / ``"pallas_interpret"``: a Pallas kernel, grid
-  ``(S, H, max_pages)``, that scalar-prefetches the block table so each
-  kv block's HBM address is known before the body runs (the
-  PrefetchScalarGridSpec pattern), does online-softmax accumulation over
-  pages, and skips pages past the slot's live extent entirely. The
-  interpret path runs the REAL kernel on CPU, so tier-1 tests exercise
-  it.
+  ``(S, H, cdiv(max_pages, pages_per_block))``, that scalar-prefetches
+  the block table so each kv block's HBM address is known before the
+  body runs (the PrefetchScalarGridSpec pattern), does online-softmax
+  accumulation over pages, and skips pages past the slot's live extent
+  entirely. The interpret path runs the REAL kernel on CPU, so tier-1
+  tests exercise it.
+
+Both kernels register with the shared kernel layer
+(:mod:`paddle_tpu.kernels`): the public entry points dispatch through
+the registry, the ``pages_per_block`` tunable (how many of a slot's
+pages one grid step streams — bit-equal output for any setting, the
+accumulation order is identical) resolves from the shared autotuner at
+trace time, and the registry's parity battery + graph-lint contract
+rule cover both.
 """
 
 from __future__ import annotations
@@ -54,10 +62,8 @@ from paddle_tpu.ops.attention import NEG_INF
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except RuntimeError:  # pragma: no cover
-        return False
+    from paddle_tpu.kernels import harness
+    return harness.on_tpu()
 
 
 # ---------------------------------------------------------------------------
@@ -91,8 +97,45 @@ def _paged_decode_lax(q, k_pages, v_pages, block_tables, lengths, scale):
 # Pallas kernel: grid (S, H, max_pages), block-table scalar prefetch
 # ---------------------------------------------------------------------------
 
-def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, page_size):
+def _online_softmax_page_fold(q, k_ref, v_ref, mask, m_scr, l_scr,
+                              acc_scr):
+    """Fold ONE (ps, H-sliced) kv page into the running (m, l, acc)
+    online-softmax state. ``mask`` (rows, ps) marks live score entries;
+    masked entries go to NEG_INF and contribute exact zeros. Shared by
+    the decode and prefill kernels — the accumulation order here IS the
+    byte-parity contract, so it must not diverge between them."""
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # (ps, Dh)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)          # (ps, Dh)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (rows, ps)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[...]                                # (rows, 128)
+    l_prev = l_scr[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)          # (rows, 1)
+    m_next = jnp.maximum(m_prev, m_cur)                # lanes broadcast
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next[:, :1])                     # (rows, ps)
+    l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[...] = m_next
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (rows, Dh)
+    acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, *rest, page_size,
+                         pages_per_block):
+    """Online-softmax over a slot's pages, ``pages_per_block`` pages per
+    grid step (the shared autotuner's tunable: fewer grid iterations,
+    deeper DMA pipelining; the per-page accumulation ORDER is identical
+    to pages_per_block=1, so outputs are bit-equal for any setting)."""
+    pb = pages_per_block
+    k_refs = rest[:pb]
+    v_refs = rest[pb:2 * pb]
+    o_ref = rest[2 * pb]
+    m_scr, l_scr, acc_scr = rest[2 * pb + 1:]
     sl = pl.program_id(0)
     pj = pl.program_id(2)
     npg = pl.num_programs(2)
@@ -107,30 +150,19 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     def _body():
         q = q_ref[0].astype(jnp.float32)               # (1, Dh)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (1, ps)
-        tok = pj * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        s = jnp.where(tok < length, s, NEG_INF)
+        for t in range(pb):
+            # tokens at/after the slot's length (incl. whole tail pages
+            # of this block, and the clamped duplicate page when pb does
+            # not divide max_pages) mask to NEG_INF -> exact-zero
+            # contributions to l and acc
+            tok = (pj * pb + t) * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (1, page_size), 1)
+            _online_softmax_page_fold(q, k_refs[t], v_refs[t],
+                                      tok < length, m_scr, l_scr,
+                                      acc_scr)
 
-        m_prev = m_scr[...]                            # (1, 128)
-        l_prev = l_scr[...]
-        m_cur = jnp.max(s, axis=1, keepdims=True)      # (1, 1)
-        m_next = jnp.maximum(m_prev, m_cur)            # lanes broadcast
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next[:, :1])                 # (1, ps)
-        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[...] = m_next
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (1, Dh)
-        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
-
-    # ragged skip: pages at/after the slot's length hold no live tokens
-    pl.when(pj * page_size < length)(_body)
+    # ragged skip: blocks wholly at/after the slot's length do nothing
+    pl.when(pj * pb * page_size < length)(_body)
 
     @pl.when(pj == npg - 1)
     def _finish():
@@ -141,24 +173,39 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
+def _paged_kv_specs(ps, dh, mp, pb):
+    """``pb`` (k, v) BlockSpec pairs per grid step: page ``j*pb + t`` of
+    the slot's block table (clamped to the last page — the clamped
+    duplicate is fully masked by the token test in the kernel body).
+    The index maps take the scalar-prefetch refs after the grid ids;
+    the block table is always the first of them."""
+    def kv_spec(t):
+        def index(s, hh, j, bt, *_rest):
+            return (bt[s, jnp.minimum(j * pb + t, mp - 1)], 0, hh, 0)
+        return pl.BlockSpec((1, ps, 1, dh), index)
+    ks = [kv_spec(t) for t in range(pb)]
+    vs = [kv_spec(t) for t in range(pb)]
+    return ks, vs
+
+
 def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
-                         interpret):
+                         interpret, pages_per_block=1):
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
     s_slots, h, dh = q.shape
     mp = block_tables.shape[1]
     ps = k_pages.shape[1]
+    pb = max(1, min(int(pages_per_block), mp))
     qs = (q * jnp.asarray(scale, q.dtype))
+    k_specs, v_specs = _paged_kv_specs(ps, dh, mp, pb)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, lengths
-        grid=(s_slots, h, mp),
+        grid=(s_slots, h, pl.cdiv(mp, pb)),
         in_specs=[
             pl.BlockSpec((1, 1, dh), lambda s, hh, j, bt, ln: (s, hh, 0)),
-            pl.BlockSpec((1, ps, 1, dh),
-                         lambda s, hh, j, bt, ln: (bt[s, j], 0, hh, 0)),
-            pl.BlockSpec((1, ps, 1, dh),
-                         lambda s, hh, j, bt, ln: (bt[s, j], 0, hh, 0)),
+            *k_specs,
+            *v_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, dh),
                                lambda s, hh, j, bt, ln: (s, hh, 0)),
@@ -168,7 +215,8 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
             pltpu.VMEM((1, dh), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_decode_kernel, page_size=ps)
+    kernel = functools.partial(_paged_decode_kernel, page_size=ps,
+                               pages_per_block=pb)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -178,7 +226,7 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
         ) if not interpret else None,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      qs, k_pages, v_pages)
+      qs, *([k_pages] * pb), *([v_pages] * pb))
     return out
 
 
@@ -209,8 +257,15 @@ def _paged_prefill_lax(q, k_pages, v_pages, block_tables, chunk_starts,
     return out.astype(q.dtype)
 
 
-def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, k_ref, v_ref,
-                          o_ref, m_scr, l_scr, acc_scr, *, page_size):
+def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, *rest,
+                          page_size, pages_per_block):
+    """Chunked-prefill analog of :func:`_paged_decode_kernel`: same
+    ``pages_per_block`` tunable, same bit-equal accumulation order."""
+    pb = pages_per_block
+    k_refs = rest[:pb]
+    v_refs = rest[pb:2 * pb]
+    o_ref = rest[2 * pb]
+    m_scr, l_scr, acc_scr = rest[2 * pb + 1:]
     sl = pl.program_id(0)
     pj = pl.program_id(2)
     npg = pl.num_programs(2)
@@ -226,33 +281,17 @@ def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, k_ref, v_ref,
 
     def _body():
         q = q_ref[0, :, 0, :].astype(jnp.float32)      # (C, Dh)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)      # (ps, Dh)
         cc = q.shape[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (C, ps)
-        tok = pj * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (cc, page_size), 1)
-        row = jax.lax.broadcasted_iota(jnp.int32, (cc, page_size), 0)
-        ok = (tok <= start + row) & (row < nv)         # causal + live lane
-        s = jnp.where(ok, s, NEG_INF)
+        for t in range(pb):
+            tok = (pj * pb + t) * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (cc, page_size), 1)
+            row = jax.lax.broadcasted_iota(jnp.int32, (cc, page_size), 0)
+            ok = (tok <= start + row) & (row < nv)     # causal + live lane
+            _online_softmax_page_fold(q, k_refs[t], v_refs[t], ok,
+                                      m_scr, l_scr, acc_scr)
 
-        m_prev = m_scr[...]                            # (C, 128)
-        l_prev = l_scr[...]
-        m_cur = jnp.max(s, axis=1, keepdims=True)      # (C, 1)
-        m_next = jnp.maximum(m_prev, m_cur)            # lanes broadcast
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next[:, :1])                 # (C, ps)
-        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[...] = m_next
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (C, Dh)
-        acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
-
-    # ragged skip: pages wholly past the chunk's live extent do nothing
-    pl.when((nv > 0) & (pj * page_size < start + nv))(_body)
+    # ragged skip: blocks wholly past the chunk's live extent do nothing
+    pl.when((nv > 0) & (pj * pb * page_size < start + nv))(_body)
 
     @pl.when(pj == npg - 1)
     def _finish():
@@ -264,24 +303,24 @@ def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, k_ref, v_ref,
 
 
 def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
-                          n_valid, scale, interpret):
+                          n_valid, scale, interpret, pages_per_block=1):
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
     s_slots, c, h, dh = q.shape
     mp = block_tables.shape[1]
     ps = k_pages.shape[1]
+    pb = max(1, min(int(pages_per_block), mp))
     qs = (q * jnp.asarray(scale, q.dtype))
+    k_specs, v_specs = _paged_kv_specs(ps, dh, mp, pb)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # block_tables, chunk_starts, n_valid
-        grid=(s_slots, h, mp),
+        grid=(s_slots, h, pl.cdiv(mp, pb)),
         in_specs=[
             pl.BlockSpec((1, c, 1, dh),
                          lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
-            pl.BlockSpec((1, ps, 1, dh),
-                         lambda s, hh, j, bt, st, nv: (bt[s, j], 0, hh, 0)),
-            pl.BlockSpec((1, ps, 1, dh),
-                         lambda s, hh, j, bt, st, nv: (bt[s, j], 0, hh, 0)),
+            *k_specs,
+            *v_specs,
         ],
         out_specs=pl.BlockSpec((1, c, 1, dh),
                                lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
@@ -291,7 +330,8 @@ def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
             pltpu.VMEM((c, dh), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_prefill_kernel, page_size=ps)
+    kernel = functools.partial(_paged_prefill_kernel, page_size=ps,
+                               pages_per_block=pb)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -301,7 +341,7 @@ def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
         ) if not interpret else None,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), chunk_starts.astype(jnp.int32),
-      n_valid.astype(jnp.int32), qs, k_pages, v_pages)
+      n_valid.astype(jnp.int32), qs, *([k_pages] * pb), *([v_pages] * pb))
     return out
 
 
@@ -319,18 +359,9 @@ def ragged_paged_decode_attention(q, k_pages, v_pages, block_tables,
     tokens per slot. Returns (S, H, Dh). ``impl``: "auto" (pallas on
     TPU, lax elsewhere), "lax", "pallas", "pallas_interpret".
     """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    if impl == "auto":
-        impl = "pallas" if (pltpu is not None and _on_tpu()) else "lax"
-    if impl == "lax":
-        return _paged_decode_lax(q, k_pages, v_pages, block_tables,
-                                 lengths, scale)
-    if impl in ("pallas", "pallas_interpret"):
-        return _paged_decode_pallas(q, k_pages, v_pages, block_tables,
-                                    lengths, scale,
-                                    interpret=impl == "pallas_interpret")
-    raise ValueError(f"unknown impl {impl!r}")
+    from paddle_tpu import kernels
+    return kernels.dispatch("ragged_paged_decode", q, k_pages, v_pages,
+                            block_tables, lengths, impl=impl, scale=scale)
 
 
 def ragged_paged_prefill_attention(q, k_pages, v_pages, block_tables,
@@ -350,18 +381,10 @@ def ragged_paged_prefill_attention(q, k_pages, v_pages, block_tables,
     ``impl``: "auto" (pallas on TPU, lax elsewhere), "lax", "pallas",
     "pallas_interpret".
     """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    if impl == "auto":
-        impl = "pallas" if (pltpu is not None and _on_tpu()) else "lax"
-    if impl == "lax":
-        return _paged_prefill_lax(q, k_pages, v_pages, block_tables,
-                                  chunk_starts, n_valid, scale)
-    if impl in ("pallas", "pallas_interpret"):
-        return _paged_prefill_pallas(q, k_pages, v_pages, block_tables,
-                                     chunk_starts, n_valid, scale,
-                                     interpret=impl == "pallas_interpret")
-    raise ValueError(f"unknown impl {impl!r}")
+    from paddle_tpu import kernels
+    return kernels.dispatch("ragged_paged_prefill", q, k_pages, v_pages,
+                            block_tables, chunk_starts, n_valid,
+                            impl=impl, scale=scale)
 
 
 def paged_prefill_attention(q, k_pages, v_pages, block_table_row,
@@ -394,3 +417,238 @@ def paged_prefill_attention(q, k_pages, v_pages, block_table_row,
     p = jnp.where(alive, p, 0.0)
     out = jnp.einsum("hct,thd->chd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entries (paddle_tpu.kernels)
+# ---------------------------------------------------------------------------
+
+def _decode_kernel_pallas(q, k_pages, v_pages, block_tables, lengths, *,
+                          block_sizes, interpret, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_decode_pallas(
+        q, k_pages, v_pages, block_tables, lengths, scale, interpret,
+        pages_per_block=block_sizes.get("pages_per_block", 1))
+
+
+def _decode_kernel_lax(q, k_pages, v_pages, block_tables, lengths, *,
+                       scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_decode_lax(q, k_pages, v_pages, block_tables, lengths,
+                             scale)
+
+
+def _decode_kernel_reference(q, k_pages, v_pages, block_tables, lengths,
+                             *, scale=None):
+    """NumPy per-slot dense attention — independent of both impls."""
+    import numpy as np
+    s_slots, h, dh = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    mp, ps = block_tables.shape[1], k_pages.shape[1]
+    qn = np.asarray(q, np.float32)
+    kp = np.asarray(k_pages, np.float32)
+    vp = np.asarray(v_pages, np.float32)
+    bt = np.asarray(block_tables)
+    ln = np.asarray(lengths)
+    outs = np.zeros((s_slots, h, dh), np.float32)
+    for sl in range(s_slots):
+        n = int(ln[sl])
+        if n == 0:
+            continue
+        k = kp[bt[sl]].reshape(mp * ps, h, dh)[:n]
+        v = vp[bt[sl]].reshape(mp * ps, h, dh)[:n]
+        s = np.einsum("hd,thd->ht", qn[sl], k) * scale
+        s = s - s.max(-1, keepdims=True)
+        p = np.exp(s)
+        p = p / p.sum(-1, keepdims=True)
+        outs[sl] = np.einsum("ht,thd->hd", p, v)
+    return jnp.asarray(outs).astype(q.dtype)
+
+
+def _make_paged_sample(seed, *, chunked):
+    import numpy as np
+    s_slots, h, dh, ps, mp = (
+        (4, 2, 16, 8, 3), (6, 4, 32, 16, 4), (8, 4, 64, 16, 6))[seed % 3]
+    c = ps  # prefill chunk = one page of queries
+    num_pages = s_slots * mp + 1
+    rng = np.random.default_rng(seed)
+    k_pages = jnp.asarray(
+        rng.standard_normal((num_pages, ps, h, dh)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.standard_normal((num_pages, ps, h, dh)), jnp.float32)
+    perm = rng.permutation(num_pages - 1)[:s_slots * mp] + 1
+    block_tables = jnp.asarray(perm.reshape(s_slots, mp), jnp.int32)
+    if not chunked:
+        q = jnp.asarray(rng.standard_normal((s_slots, h, dh)),
+                        jnp.float32)
+        lengths = jnp.asarray(
+            rng.integers(0, mp * ps + 1, s_slots), jnp.int32)
+        return (q, k_pages, v_pages, block_tables, lengths), {}
+    q = jnp.asarray(rng.standard_normal((s_slots, c, h, dh)), jnp.float32)
+    starts = jnp.asarray(
+        rng.integers(0, (mp - 1) * ps, s_slots), jnp.int32)
+    n_valid = jnp.asarray(rng.integers(0, c + 1, s_slots), jnp.int32)
+    return (q, k_pages, v_pages, block_tables, starts, n_valid), {}
+
+
+def _paged_tune_signature(args, kwargs):
+    q, k_pages, _v, bt = args[0], args[1], args[2], args[3]
+    sig = [("s", q.shape[0]), ("h", k_pages.shape[2]),
+           ("d", q.shape[-1]), ("ps", k_pages.shape[1]),
+           ("mp", bt.shape[1])]
+    if q.ndim == 4:                      # prefill: chunk width matters
+        sig.insert(1, ("c", q.shape[1]))
+    return tuple(sig)
+
+
+def _paged_vmem_estimate(args, kwargs, blocks):
+    q, k_pages = args[0], args[1]
+    ps, dh = k_pages.shape[1], k_pages.shape[-1]
+    c = q.shape[1] if q.ndim == 4 else 1
+    pb = blocks.get("pages_per_block", 1)
+    # fp32 working set: pb (k, v) page pairs + q/acc + m/l lane scratch
+    return 4 * (2 * pb * ps * dh + 2 * c * dh + 2 * c * 128
+                + 2 * c * ps)
+
+
+def _decode_donation_probe():
+    (q, k_pages, v_pages, block_tables, lengths), _ = \
+        _make_paged_sample(0, chunked=False)
+
+    def step(kp, vp, q, bt, lens):
+        # the engine's real pattern: write this step's token K/V into
+        # the pages, attend THROUGH THE PALLAS BODY (interpret lowering
+        # — the structure XLA aliases, incl. the pages-passed-
+        # pages_per_block-times operand shape), hand the pages back
+        kp = kp.at[1, 0].set(q[0])
+        vp = vp.at[1, 0].set(q[0])
+        out = _decode_kernel_pallas(
+            q, kp, vp, bt, lens,
+            block_sizes={"pages_per_block": 4}, interpret=True)
+        return out, kp, vp
+
+    args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in (k_pages, v_pages, q, block_tables, lengths))
+    return step, args, (0, 1)
+
+
+def _prefill_kernel_pallas(q, k_pages, v_pages, block_tables,
+                           chunk_starts, n_valid, *, block_sizes,
+                           interpret, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_prefill_pallas(
+        q, k_pages, v_pages, block_tables, chunk_starts, n_valid, scale,
+        interpret, pages_per_block=block_sizes.get("pages_per_block", 1))
+
+
+def _prefill_kernel_lax(q, k_pages, v_pages, block_tables, chunk_starts,
+                        n_valid, *, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_prefill_lax(q, k_pages, v_pages, block_tables,
+                              chunk_starts, n_valid, scale)
+
+
+def _prefill_kernel_reference(q, k_pages, v_pages, block_tables,
+                              chunk_starts, n_valid, *, scale=None):
+    """NumPy per-slot, per-row causal attention over the slot's pages."""
+    import numpy as np
+    s_slots, c, h, dh = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    mp, ps = block_tables.shape[1], k_pages.shape[1]
+    qn = np.asarray(q, np.float32)
+    kp = np.asarray(k_pages, np.float32)
+    vp = np.asarray(v_pages, np.float32)
+    bt = np.asarray(block_tables)
+    st = np.asarray(chunk_starts)
+    nv = np.asarray(n_valid)
+    outs = np.zeros((s_slots, c, h, dh), np.float32)
+    for sl in range(s_slots):
+        k = kp[bt[sl]].reshape(mp * ps, h, dh)
+        v = vp[bt[sl]].reshape(mp * ps, h, dh)
+        for r in range(int(nv[sl])):
+            limit = int(st[sl]) + r + 1          # causal horizon
+            s = np.einsum("hd,thd->ht", qn[sl, r], k[:limit]) * scale
+            s = s - s.max(-1, keepdims=True)
+            p = np.exp(s)
+            p = p / p.sum(-1, keepdims=True)
+            outs[sl, r] = np.einsum("ht,thd->hd", p, v[:limit])
+    return jnp.asarray(outs).astype(q.dtype)
+
+
+def _prefill_donation_probe():
+    (q, k_pages, v_pages, block_tables, starts, n_valid), _ = \
+        _make_paged_sample(0, chunked=True)
+
+    def step(kp, vp, q, bt, st, nv):
+        kp = kp.at[1, 0].set(q[0, 0])
+        vp = vp.at[1, 0].set(q[0, 0])
+        out = _prefill_kernel_pallas(
+            q, kp, vp, bt, st, nv,
+            block_sizes={"pages_per_block": 4}, interpret=True)
+        return out, kp, vp
+
+    args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in (k_pages, v_pages, q, block_tables, starts,
+                           n_valid))
+    return step, args, (0, 1)
+
+
+def _register_paged_kernels():
+    from paddle_tpu import kernels
+    pb_candidates = {"pages_per_block": (1, 2, 4)}
+    kernels.register(kernels.KernelSpec(
+        name="ragged_paged_decode",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(S,H,Dh)", "k_pages": "(P,ps,H,Dh)",
+                         "v_pages": "(P,ps,H,Dh)",
+                         "block_tables": "(S,mp) i32",
+                         "lengths": "(S,) i32"},
+            out_layout="(S,H,Dh)",
+            donatable=("k_pages", "v_pages"),
+            grid="(S, H, cdiv(mp,pages_per_block)) block-table scalar "
+                 "prefetch, dead-page skip",
+            block_candidates=pb_candidates,
+            atol=2e-5, rtol=2e-5),
+        pallas_fn=_decode_kernel_pallas,
+        lax_fn=_decode_kernel_lax,
+        reference_fn=_decode_kernel_reference,
+        sample_inputs=lambda seed: _make_paged_sample(seed, chunked=False),
+        pallas_sites=(
+            "paddle_tpu.serving.decode_attention:_paged_decode_pallas",),
+        tune_signature=_paged_tune_signature,
+        vmem_estimate=_paged_vmem_estimate,
+        donation_probe=_decode_donation_probe))
+    kernels.register(kernels.KernelSpec(
+        name="ragged_paged_prefill",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(S,C,H,Dh)", "k_pages": "(P,ps,H,Dh)",
+                         "v_pages": "(P,ps,H,Dh)",
+                         "block_tables": "(S,mp) i32",
+                         "chunk_starts": "(S,) i32",
+                         "n_valid": "(S,) i32"},
+            out_layout="(S,C,H,Dh)",
+            donatable=("k_pages", "v_pages"),
+            grid="(S, H, cdiv(mp,pages_per_block)) block-table scalar "
+                 "prefetch, causal + live-lane mask",
+            block_candidates=pb_candidates,
+            atol=2e-5, rtol=2e-5),
+        pallas_fn=_prefill_kernel_pallas,
+        lax_fn=_prefill_kernel_lax,
+        reference_fn=_prefill_kernel_reference,
+        sample_inputs=lambda seed: _make_paged_sample(seed, chunked=True),
+        pallas_sites=(
+            "paddle_tpu.serving.decode_attention:_paged_prefill_pallas",),
+        tune_signature=_paged_tune_signature,
+        vmem_estimate=_paged_vmem_estimate,
+        donation_probe=_prefill_donation_probe))
+
+
+_register_paged_kernels()

@@ -74,28 +74,18 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
-# Known jaxlib limitation, not a paddle_tpu bug: the NON-causal ring
-# flash path lowers a PartitionId instruction that XLA refuses under
-# SPMD partitioning when the Pallas kernel runs in interpret mode
-# ("PartitionId instruction is not supported for SPMD partitioning since
-# the meaning is ambiguous"). The causal/grads variants do not hit it,
-# and on real TPU (compiled Mosaic, no interpret shim) the path works.
-# xfail(strict=True) so tier-1 stays a clean signal AND flags the day a
-# jaxlib upgrade fixes it.
-# raises=RuntimeError (XlaRuntimeError's base): a numerical regression
-# raising AssertionError must FAIL, not hide behind this xfail
-_PARTITION_ID_XFAIL = pytest.mark.xfail(
-    reason="jaxlib: PartitionId not supported for SPMD in Pallas "
-           "interpret mode (non-causal ring flash path only)",
-    raises=RuntimeError, strict=True)
-
-
 class TestRingFlash:
-    """Pallas-kernel-per-block ring attention (impl="flash_interpret" runs
-    the same kernels in interpret mode on CPU) vs the full-attention
-    reference — forward and backward."""
+    """Ring attention with the flash merge (impl="flash_interpret" runs
+    each ring block through the shared kernel harness's lax fallback on
+    CPU — paddle_tpu.kernels) vs the full-attention reference — forward
+    and backward.
 
-    @_PARTITION_ID_XFAIL
+    Historical note: the two non-causal variants here were strict-
+    xfailed for several rounds ("PartitionId not supported for SPMD
+    partitioning") — the non-causal path emitted a DEAD axis_index whose
+    PartitionId the partitioner refused. The shared-harness migration
+    dropped the dead computation, so they pass everywhere now."""
+
     def test_matches_full(self, sp_mesh):
         q, k, v = _qkv(jax.random.PRNGKey(0))
         ref = A.scaled_dot_product_attention(q, k, v)
@@ -115,7 +105,6 @@ class TestRingFlash:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
-    @_PARTITION_ID_XFAIL
     def test_padding_bias(self, sp_mesh):
         q, k, v = _qkv(jax.random.PRNGKey(2))
         mask = jnp.arange(32)[None, :] < jnp.array([20, 32])[:, None]

@@ -54,6 +54,7 @@ def iter_api():
         "paddle_tpu.amp": pt.amp,
         "paddle_tpu.metrics": pt.metrics,
         "paddle_tpu.inference": pt.inference,
+        "paddle_tpu.kernels": pt.kernels,
         "paddle_tpu.fleet": pt.fleet,
         "paddle_tpu.observability": pt.observability,
         "paddle_tpu.resilience": pt.resilience,

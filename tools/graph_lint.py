@@ -290,11 +290,24 @@ def bucket_coverage_report(suppressions):
     return report
 
 
+def lint_kernel_registry(suppressions, cost=False):
+    """The kernel-layer contract surface (ISSUE 12): every registered
+    Pallas kernel's declared contract (layouts, donation-safety via a
+    lowered probe's ``tf.aliasing_output``, zero-collective lowering,
+    autotuner blocks within the candidate set) is verified against what
+    actually lowers, and every ``pallas_call`` in ``ops/``, ``parallel/``
+    and ``serving/`` must belong to a registered kernel (deliberate
+    exceptions: ``tools/kernel_registry_allowlist.txt``; stale entries
+    are rejected like stale suppressions)."""
+    from paddle_tpu import kernels
+    return kernels.lint_registry(suppressions)
+
+
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
                   lint_convgroup, lint_serving_decode,
                   lint_serving_prefill, lint_embedding_install,
-                  lint_embedding_lookup],
+                  lint_embedding_lookup, lint_kernel_registry],
 }
 
 

@@ -137,12 +137,52 @@ assert r["speedup_vs_cold"] > 1.0, \
 print("embedding serving dryrun metrics OK")
 '
 
+# kernel-layer bench smoke: the shared autotuner must measure all three
+# single-device Pallas kernels (flash, ragged decode, ragged prefill)
+# across 3 shape buckets through ONE dispatch harness, hit its cache on
+# re-resolution, and load the committed tools/kernel_tune.json with zero
+# stale entries (a kernel contract-version bump without a reseed fails
+# here, not in production)
+echo "== bench smoke (kernels dryrun) =="
+KERNELS_OUT="$(python bench.py --model kernels --dryrun)"
+if echo "$KERNELS_OUT" | grep -q '"error"'; then
+  echo "kernels bench dryrun failed: $KERNELS_OUT"
+  exit 1
+fi
+echo "$KERNELS_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("kernels", "tuner_cache_hits", "tuner_cache_misses",
+          "tuner_stale_entries", "committed_cache_entries",
+          "committed_cache_stale", "impl"):
+    assert k in r, f"BENCH_KERNELS missing {k}"
+ks = r["kernels"]
+assert set(ks) == {"flash_attention", "ragged_paged_decode",
+                   "ragged_paged_prefill"}, sorted(ks)
+for name, buckets in ks.items():
+    assert len(buckets) == 3, f"{name}: expected 3 shape buckets"
+    for key, b in buckets.items():
+        assert b["tuned_s"] <= b["default_s"] * 1.001, \
+            f"{key}: tuner picked a slower config than the default"
+assert r["tuner_cache_hits"] >= 3, "measured buckets did not cache-hit"
+assert r["committed_cache_entries"] > 0, "committed tune cache empty"
+assert r["committed_cache_stale"] == 0, "stale committed tune entries"
+print("kernels dryrun OK (geomean %sx vs default blocks)" % r["value"])
+'
+
 # static self-lint: the zoo's step functions (LeNet/ResNet-18 train, GPT
 # decode, VGG conv-group dropout, serving decode/prefill, embedding
 # install/lookup) must be free of error-severity graph hazards (host
 # syncs, key reuse, tracer branches); accepted warnings live in
 # tools/graph_lint_suppressions.txt (stale entries are themselves an
-# error). The --cost tier adds the HLO rules — zero collectives in
+# error). The preset now also runs the kernel-registry rule: every
+# registered Pallas kernel's contract (layouts, donation aliasing in
+# lowered HLO, zero collectives, autotuner blocks within candidates)
+# is verified, and any pallas_call in ops/, parallel/ or serving/ that
+# bypasses the registry fails the build unless allowlisted in
+# tools/kernel_registry_allowlist.txt (stale allowlist entries are
+# rejected like stale suppressions). The --cost tier adds the HLO rules
+# — zero collectives in
 # single-device serving steps, peak-HBM/flops under the committed
 # budgets, warmup bucket-coverage proof — and --cost-diff fails the
 # build when any surface's static flops / peak-HBM / collective bytes
