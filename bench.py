@@ -1109,6 +1109,9 @@ SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                   "slo_burn_rate", "slo_alerts_total",
                   "trace_json", "trace_spans",
                   "prefix_variant",
+                  "tokens_per_hbm_byte", "tokens_per_hbm_byte_bf16",
+                  "quant_static_bytes_ratio", "quant_speedup",
+                  "quant_variant", "spec_accept_rate", "spec_variant",
                   "mean_slot_occupancy", "page_utilization_peak",
                   "decode_recompiles_after_warmup", "num_requests",
                   "num_slots", "page_size", "device")
@@ -1268,13 +1271,16 @@ def run_bench_serving(dev, dryrun=False):
             peak_util = max(peak_util,
                             reg.gauge("serving_page_utilization").value())
         dt = time.perf_counter() - t0
+        streams = []
         for r, u in zip(rids, useful):
             got = eng.result(r)
             assert got is not None and len(got) == u, \
                 "engine/ref divergence"
+            streams.append(got)
         ttft_h = reg.histogram("serving_ttft_seconds")
         qw_h = reg.histogram("serving_queue_wait_seconds")
         return {
+            "streams": streams,
             "dt": dt,
             "decode_s": reg.histogram("serving_decode_step_seconds"
                                       ).summary()["sum"],
@@ -1423,6 +1429,161 @@ def run_bench_serving(dev, dryrun=False):
         "recompiles": det2.recompiles,
     }
 
+    # --- int8 paged-KV variant (ISSUE 13): the same requests through an
+    # int8 page pool with per-token-row scales, attending via the
+    # dequant-attend kernels. Tokens may deviate from the bf16 stream
+    # only within the quantization quality budget (quant_token_match
+    # reports the agreement honestly); throughput is its own stream's
+    # tokens over its own decode time, best-of-2 like the baseline.
+    reg_q = obs.MetricsRegistry()
+    eng_q = serving.ServingEngine(
+        model, params, num_slots=num_slots, page_size=page_size,
+        max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
+        attn_impl=attn_impl, cache_dtype=jnp.int8, registry=reg_q,
+        prefix_sharing=False, tracer=obs.Tracer(enabled=False))
+    eng_q.warmup(cost_gauges=False)
+    det_q = obs.RecompileDetector("serving_bench_int8", warmup=0,
+                                  registry=reg_q)
+
+    def quant_pass():
+        reg_q.unregister("serving_decode_step_seconds")
+        rids_q = [eng_q.submit(p, cap, eos_id=e)
+                  for p, e in zip(prompts, eos_ids)]
+        while not eng_q.scheduler.idle():
+            eng_q.step()
+        outs = [eng_q.result(r) for r in rids_q]
+        dq = reg_q.histogram("serving_decode_step_seconds"
+                             ).summary()["sum"]
+        return dq, outs
+
+    qp = min((quant_pass() for _ in range(2)), key=lambda r: r[0])
+    det_q.check()
+    dq_decode_s, outs_q = qp
+    tokens_q = int(sum(len(o) for o in outs_q))
+    quant_tps = tokens_q / max(dq_decode_s, 1e-9)
+    agree = compared = 0
+    for base_t, q_t in zip(ep["streams"], outs_q):
+        m = min(len(base_t), len(q_t))
+        agree += int((np.asarray(base_t[:m]) == np.asarray(q_t[:m])).sum())
+        compared += m
+    quant_speedup = quant_tps / max(engine_tps, 1e-9)
+    quant_variant = {
+        "decode_tokens_per_sec": round(quant_tps, 2),
+        "decode_seconds": round(dq_decode_s, 3),
+        "tokens": tokens_q,
+        "token_match_vs_bf16": round(agree / max(compared, 1), 4),
+        "recompiles": det_q.recompiles,
+    }
+
+    # --- speculative variant (ISSUE 13): draft proposes spec_k tokens
+    # per slot, the target verifies them in ONE batched-prefill-shaped
+    # step. Random init has no trained small draft, so the draft IS the
+    # target (self-draft): accept rate ~1.0 exercises the long-accept
+    # path and the mechanism's overhead honestly. The acceptance GATE:
+    # greedy streams must be BIT-EXACT vs the non-speculative engine.
+    reg_s = obs.MetricsRegistry()
+    eng_s = serving.ServingEngine(
+        model, params, num_slots=num_slots, page_size=page_size,
+        max_tokens_per_slot=hi + cap, prefill_chunk=chunk,
+        attn_impl=attn_impl, cache_dtype=cache_dtype, registry=reg_s,
+        tracer=obs.Tracer(enabled=False), draft_model=model,
+        draft_params=params, spec_k=4)
+    eng_s.warmup(cost_gauges=False)
+    det_s = obs.RecompileDetector("serving_bench_spec", warmup=0,
+                                  registry=reg_s)
+
+    def spec_pass():
+        # the counters are monotonic across passes: report THIS pass's
+        # deltas so the committed proposed/accepted match the same
+        # single pass the timing and streams come from
+        p0 = reg_s.counter("serving_spec_proposed_total").value()
+        a0 = reg_s.counter("serving_spec_accepted_total").value()
+        reg_s.unregister("serving_decode_step_seconds")
+        rids_s = [eng_s.submit(p, cap, eos_id=e)
+                  for p, e in zip(prompts, eos_ids)]
+        while not eng_s.scheduler.idle():
+            eng_s.step()
+        outs = [eng_s.result(r) for r in rids_s]
+        ds = reg_s.histogram("serving_decode_step_seconds"
+                             ).summary()["sum"]
+        proposed = reg_s.counter("serving_spec_proposed_total"
+                                 ).value() - p0
+        accepted = reg_s.counter("serving_spec_accepted_total"
+                                 ).value() - a0
+        return ds, outs, proposed, accepted
+
+    sp = min((spec_pass() for _ in range(2)), key=lambda r: r[0])
+    det_s.check()
+    ds_decode_s, outs_s, spec_proposed, spec_accepted = sp
+    for base_t, s_t in zip(ep["streams"], outs_s):
+        if not np.array_equal(base_t, s_t):
+            raise RuntimeError(
+                "speculative greedy diverged from non-speculative "
+                "greedy — the bit-exactness gate failed")
+    spec_accept_rate = spec_accepted / max(spec_proposed, 1)
+    tokens_s = int(sum(len(o) for o in outs_s))
+    spec_variant = {
+        "decode_tokens_per_sec": round(tokens_s /
+                                       max(ds_decode_s, 1e-9), 2),
+        "decode_seconds": round(ds_decode_s, 3),
+        "spec_k": eng_s.spec_k,
+        "proposed": int(spec_proposed),
+        "accepted": int(spec_accepted),
+        "draft": "self (random init has no trained small draft; "
+                 "exercises the long-accept path)",
+        "exact_vs_nonspeculative": True,
+        "recompiles": det_s.recompiles,
+    }
+
+    # --- static tokens-per-HBM-byte probe (ISSUE 13 acceptance): lower
+    # the decode step of a bf16 and an int8 engine with an identical,
+    # KV-dominated pool through the PR 7 cost model, and read each
+    # step's KV-cache HBM bytes from the CostReport's argument
+    # accounting. tokens_per_hbm_byte = the live tokens the pool hosts
+    # per byte of KV HBM the decode step holds — the serving-capacity
+    # number the int8 pool doubles (per token: 2x H*Dh bytes bf16 vs
+    # H*Dh + 8 scale bytes int8).
+    from paddle_tpu import analysis
+    from paddle_tpu.models.gpt import GPTConfig as _Cfg
+    pcfg = _Cfg(vocab_size=256, hidden_size=128, num_layers=2,
+                num_heads=4, ffn_size=256, max_position=1024,
+                dropout=0.0, attn_impl="xla")
+    pmodel = GPT(pcfg)
+    pparams = pmodel.init(jax.random.PRNGKey(2))
+    p_pages, p_ps = 2049, 16
+
+    def probe(dtype):
+        engp = serving.ServingEngine(
+            pmodel, pparams, num_slots=8, page_size=p_ps,
+            max_tokens_per_slot=512, num_pages=p_pages,
+            attn_impl="lax", cache_dtype=dtype, decode_block=8)
+        c = engp.cache.config
+        pages_abs = analysis.abstractify(engp.cache.pages)
+        args = (analysis.abstractify(engp.params), pages_abs,
+                jax.ShapeDtypeStruct((8, 8), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32),
+                jax.ShapeDtypeStruct((8,), jnp.int32))
+        cost = analysis.estimate_cost(engp.decode_step, *args,
+                                      name=f"decode_{dtype}")
+        import math as _math
+        kv_bytes = sum(
+            _math.prod(a.shape) * jnp.dtype(a.dtype).itemsize
+            for a in jax.tree_util.tree_leaves(pages_abs))
+        # sanity: the KV pool really is inside the step's arg bytes
+        assert cost.arg_bytes > kv_bytes > 0
+        capacity_tokens = (c.num_pages - 1) * c.page_size
+        return capacity_tokens / kv_bytes, cost
+
+    tpb_int8, cost_int8 = probe(jnp.int8)
+    tpb_bf16, cost_bf16 = probe(jnp.bfloat16)
+    quant_static_ratio = tpb_int8 / tpb_bf16
+    if quant_static_ratio < 1.8:
+        raise RuntimeError(
+            f"static tokens-per-HBM-byte ratio {quant_static_ratio:.3f} "
+            "< 1.8x the bf16 baseline — the int8 pool lost its bytes "
+            "advantage")
+
     # --- trace canary: a tiny engine with a deliberately starved page
     # pool + an EDF-boosted deadline, so the exported timeline ALWAYS
     # carries scheduler-decision annotations (sched_skip / sched_boost)
@@ -1522,6 +1683,16 @@ def run_bench_serving(dev, dryrun=False):
         "trace_json": trace_path,
         "trace_spans": len(tracer.spans()),
         "prefix_variant": prefix_variant,
+        # ISSUE 13: quantized pool + speculative decoding. The static
+        # keys come from the cost model (deterministic); the measured
+        # keys are this box's wall clock, best-of-2.
+        "tokens_per_hbm_byte": round(tpb_int8, 9),
+        "tokens_per_hbm_byte_bf16": round(tpb_bf16, 9),
+        "quant_static_bytes_ratio": round(quant_static_ratio, 4),
+        "quant_speedup": round(quant_speedup, 4),
+        "quant_variant": quant_variant,
+        "spec_accept_rate": round(spec_accept_rate, 4),
+        "spec_variant": spec_variant,
         "mean_slot_occupancy": round(float(np.mean(occ)), 4),
         "page_utilization_peak": round(peak_util, 4),
         "decode_recompiles_after_warmup": det.recompiles,
@@ -1552,6 +1723,19 @@ def run_bench_serving(dev, dryrun=False):
         raise RuntimeError("prefix-sharing variant recompiled "
                            f"{prefix_variant['recompiles']}x — CoW/"
                            "prefill shapes drifted")
+    if quant_variant["recompiles"] != 0:
+        raise RuntimeError("int8 variant recompiled "
+                           f"{quant_variant['recompiles']}x — the "
+                           "quantized decode/prefill buckets drifted")
+    if spec_variant["recompiles"] != 0:
+        raise RuntimeError("speculative variant recompiled "
+                           f"{spec_variant['recompiles']}x — a "
+                           "draft/verify bucket missed warmup")
+    if not dryrun and quant_speedup < 1.0:
+        raise RuntimeError(
+            f"int8 decode tokens/s regressed vs the bf16 baseline "
+            f"({quant_speedup:.3f}x) — the quantized path must be no "
+            "worse on this box")
     import os
     path = serving_json_path(dryrun)
     committed = {k: v for k, v in result.items() if k != "_telemetry"}

@@ -35,10 +35,12 @@ rebuild). Four parts:
 
 from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
                                             PageOverflowError,
-                                            prompt_prefix_digests)
+                                            prompt_prefix_digests,
+                                            quantize_kv)
 from paddle_tpu.serving.decode_attention import (
     paged_prefill_attention, ragged_paged_decode_attention,
-    ragged_paged_prefill_attention)
+    ragged_paged_decode_int8_attention, ragged_paged_prefill_attention,
+    ragged_paged_prefill_int8_attention)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           LoadShedError, Reject, Request,
                                           SLOScheduler, SlotState)
@@ -48,7 +50,10 @@ from paddle_tpu.serving import fleet
 __all__ = [
     "PagedCacheConfig", "PagedKVCache", "PageOverflowError",
     "paged_prefill_attention", "ragged_paged_decode_attention",
-    "ragged_paged_prefill_attention", "prompt_prefix_digests",
+    "ragged_paged_decode_int8_attention",
+    "ragged_paged_prefill_attention",
+    "ragged_paged_prefill_int8_attention", "prompt_prefix_digests",
+    "quantize_kv",
     "ContinuousBatchingScheduler", "SLOScheduler", "LoadShedError",
     "Reject", "Request", "SlotState",
     "ServingEngine", "SlotMigrationError", "fleet",

@@ -41,6 +41,19 @@ pages one grid step streams — bit-equal output for any setting, the
 accumulation order is identical) resolves from the shared autotuner at
 trace time, and the registry's parity battery + graph-lint contract
 rule cover both.
+
+**Dequant-attend int8 variants** (ISSUE 13):
+``ragged_paged_decode_int8_attention`` and
+``ragged_paged_prefill_int8_attention`` attend over an INT8 page pool
+with per-token-row fp32 scales (``paged_cache.quantize_kv``'s layout).
+The Pallas bodies stream the int8 pages through the SAME
+``_online_softmax_page_fold`` with the scale broadcast fused into the
+QK and PV products — no dequantized fp page is ever materialized, HBM
+traffic per attended token halves (the bytes-per-token lever the cost
+model gates in CI). Registered like the fp kernels: lax fallbacks with
+identical scale-after-dot numerics, independent dense references,
+contracts with donation-safe pages AND scales, and the shared
+``pages_per_block`` tunable.
 """
 
 from __future__ import annotations
@@ -98,17 +111,28 @@ def _paged_decode_lax(q, k_pages, v_pages, block_tables, lengths, scale):
 # ---------------------------------------------------------------------------
 
 def _online_softmax_page_fold(q, k_ref, v_ref, mask, m_scr, l_scr,
-                              acc_scr):
+                              acc_scr, k_scale=None, v_scale=None):
     """Fold ONE (ps, H-sliced) kv page into the running (m, l, acc)
     online-softmax state. ``mask`` (rows, ps) marks live score entries;
     masked entries go to NEG_INF and contribute exact zeros. Shared by
     the decode and prefill kernels — the accumulation order here IS the
-    byte-parity contract, so it must not diverge between them."""
+    byte-parity contract, so it must not diverge between them.
+
+    ``k_scale``/``v_scale`` (ps,) are the int8 page pool's per-token-row
+    dequant scales (None on the fp path): the scale broadcast is fused
+    INTO the QK and PV products — the int8 page goes straight into the
+    dot and the per-token scale multiplies the (rows, ps) score/weight
+    matrix, so no dequantized fp page is ever materialized (the TPP
+    fused-microkernel shape). The m/l/acc update sequence is identical
+    either way, so the int8 kernels inherit the same per-page
+    accumulation-order contract."""
     k = k_ref[0, :, 0, :].astype(jnp.float32)          # (ps, Dh)
     v = v_ref[0, :, 0, :].astype(jnp.float32)          # (ps, Dh)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)            # (rows, ps)
+    if k_scale is not None:
+        s = s * k_scale[None, :]
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_scr[...]                                # (rows, 128)
@@ -119,23 +143,46 @@ def _online_softmax_page_fold(q, k_ref, v_ref, mask, m_scr, l_scr,
     p = jnp.exp(s - m_next[:, :1])                     # (rows, ps)
     l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
     m_scr[...] = m_next
+    if v_scale is not None:
+        p = p * v_scale[None, :]
     pv = jax.lax.dot_general(
         p, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)            # (rows, Dh)
     acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
 
 
+def _split_kv_refs(rest, pb, quantized):
+    """Unpack a paged kernel's trailing refs: ``pb`` k blocks, ``pb`` v
+    blocks, (quantized only) ``pb`` k-scale + ``pb`` v-scale rows, then
+    the output ref and the three online-softmax scratch buffers. ONE
+    unpacking convention for the fp and int8 variants of both kernels."""
+    k_refs = rest[:pb]
+    v_refs = rest[pb:2 * pb]
+    if quantized:
+        ks_refs = rest[2 * pb:3 * pb]
+        vs_refs = rest[3 * pb:4 * pb]
+        base = 4 * pb
+    else:
+        ks_refs = vs_refs = (None,) * pb
+        base = 2 * pb
+    o_ref = rest[base]
+    m_scr, l_scr, acc_scr = rest[base + 1:]
+    return k_refs, v_refs, ks_refs, vs_refs, o_ref, m_scr, l_scr, acc_scr
+
+
 def _paged_decode_kernel(bt_ref, len_ref, q_ref, *rest, page_size,
-                         pages_per_block):
+                         pages_per_block, quantized=False):
     """Online-softmax over a slot's pages, ``pages_per_block`` pages per
     grid step (the shared autotuner's tunable: fewer grid iterations,
     deeper DMA pipelining; the per-page accumulation ORDER is identical
-    to pages_per_block=1, so outputs are bit-equal for any setting)."""
+    to pages_per_block=1, so outputs are bit-equal for any setting).
+    ``quantized`` is ONE static flag, not a second kernel: the int8
+    page blocks ride with their per-token scale rows and the scales
+    fuse into the shared fold — grid, ragged skip, and finish logic
+    cannot diverge between the fp and dequant-attend variants."""
     pb = pages_per_block
-    k_refs = rest[:pb]
-    v_refs = rest[pb:2 * pb]
-    o_ref = rest[2 * pb]
-    m_scr, l_scr, acc_scr = rest[2 * pb + 1:]
+    (k_refs, v_refs, ks_refs, vs_refs, o_ref, m_scr, l_scr,
+     acc_scr) = _split_kv_refs(rest, pb, quantized)
     sl = pl.program_id(0)
     pj = pl.program_id(2)
     npg = pl.num_programs(2)
@@ -157,9 +204,11 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, *rest, page_size,
             # contributions to l and acc
             tok = (pj * pb + t) * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, (1, page_size), 1)
-            _online_softmax_page_fold(q, k_refs[t], v_refs[t],
-                                      tok < length, m_scr, l_scr,
-                                      acc_scr)
+            _online_softmax_page_fold(
+                q, k_refs[t], v_refs[t], tok < length, m_scr, l_scr,
+                acc_scr,
+                k_scale=ks_refs[t][0, :] if quantized else None,
+                v_scale=vs_refs[t][0, :] if quantized else None)
 
     # ragged skip: blocks wholly at/after the slot's length do nothing
     pl.when(pj * pb * page_size < length)(_body)
@@ -188,16 +237,39 @@ def _paged_kv_specs(ps, dh, mp, pb):
     return ks, vs
 
 
+def _paged_scale_specs(ps, mp, pb):
+    """``pb`` (k_scale, v_scale) BlockSpec pairs — one (1, ps) scale row
+    per streamed page, indexed by the SAME block-table entry as the page
+    itself, so a page and its dequant scales always arrive together."""
+    def sc_spec(t):
+        def index(s, hh, j, bt, *_rest):
+            return (bt[s, jnp.minimum(j * pb + t, mp - 1)], 0)
+        return pl.BlockSpec((1, ps), index)
+    ks = [sc_spec(t) for t in range(pb)]
+    vs = [sc_spec(t) for t in range(pb)]
+    return ks, vs
+
+
 def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
-                         interpret, pages_per_block=1):
+                         interpret, pages_per_block=1, k_scales=None,
+                         v_scales=None):
+    """``k_scales``/``v_scales`` given = the dequant-attend variant:
+    same grid and BlockSpecs plus one (1, ps) scale row per streamed
+    page, fused into the shared fold inside the ONE kernel body."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
+    quantized = k_scales is not None
     s_slots, h, dh = q.shape
     mp = block_tables.shape[1]
     ps = k_pages.shape[1]
     pb = max(1, min(int(pages_per_block), mp))
     qs = (q * jnp.asarray(scale, q.dtype))
     k_specs, v_specs = _paged_kv_specs(ps, dh, mp, pb)
+    sc_specs, sc_args = [], []
+    if quantized:
+        ks_specs, vs_specs = _paged_scale_specs(ps, mp, pb)
+        sc_specs = [*ks_specs, *vs_specs]
+        sc_args = [*([k_scales] * pb), *([v_scales] * pb)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, lengths
@@ -206,6 +278,7 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
             pl.BlockSpec((1, 1, dh), lambda s, hh, j, bt, ln: (s, hh, 0)),
             *k_specs,
             *v_specs,
+            *sc_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, dh),
                                lambda s, hh, j, bt, ln: (s, hh, 0)),
@@ -216,7 +289,7 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
         ],
     )
     kernel = functools.partial(_paged_decode_kernel, page_size=ps,
-                               pages_per_block=pb)
+                               pages_per_block=pb, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -226,8 +299,59 @@ def _paged_decode_pallas(q, k_pages, v_pages, block_tables, lengths, scale,
         ) if not interpret else None,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      qs, *([k_pages] * pb), *([v_pages] * pb))
+      qs, *([k_pages] * pb), *([v_pages] * pb), *sc_args)
     return out
+
+
+# ---------------------------------------------------------------------------
+# int8 dequant-attend decode: same grid, scales fused into QK/PV
+# ---------------------------------------------------------------------------
+
+def _paged_decode_int8_lax(q, k_pages, v_pages, k_scales, v_scales,
+                           block_tables, lengths, scale):
+    """Lax fallback of the dequant-attend decode kernel: gather the INT8
+    pages (half the HBM bytes of bf16) and fold the per-token-row scales
+    into the score and weight matrices — structurally the same
+    scale-after-dot order as the Pallas body, so numerics agree. The
+    int8 pools pass through :func:`slim.int8_resident` so a frozen
+    graph that bakes them as constants cannot be constant-folded to fp
+    (the keep-quantized idiom, shared with weight PTQ)."""
+    from paddle_tpu import slim
+    k_pages = slim.int8_resident(k_pages)
+    v_pages = slim.int8_resident(v_pages)
+    s_slots, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    kg = k_pages[block_tables]                  # (S, mp, ps, H, Dh) int8
+    vg = v_pages[block_tables]
+    ksg = k_scales[block_tables]                # (S, mp, ps) f32
+    vsg = v_scales[block_tables]
+    scores = jnp.einsum("shd,smthd->shmt", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    scores = scores * ksg[:, None]              # dequant fused post-dot
+    scores = scores.reshape(s_slots, h, mp * ps)
+    tok = jnp.arange(mp * ps, dtype=jnp.int32)
+    valid = tok[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    alive = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0).reshape(s_slots, h, mp, ps)
+    p = p * vsg[:, None]                        # dequant fused pre-PV
+    out = jnp.einsum("shmt,smthd->shd", p, vg.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_decode_int8_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                              block_tables, lengths, scale, interpret,
+                              pages_per_block=1):
+    """The dequant-attend decode entry: the SAME kernel body as the fp
+    path with ``quantized=True`` — per-page scale rows ride as ``pb``
+    extra scalar-prefetched blocks, fused into the QK/PV products
+    inside the shared fold (no materialized fp page)."""
+    return _paged_decode_pallas(q, k_pages, v_pages, block_tables,
+                                lengths, scale, interpret,
+                                pages_per_block=pages_per_block,
+                                k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------
@@ -258,14 +382,14 @@ def _paged_prefill_lax(q, k_pages, v_pages, block_tables, chunk_starts,
 
 
 def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, *rest,
-                          page_size, pages_per_block):
+                          page_size, pages_per_block, quantized=False):
     """Chunked-prefill analog of :func:`_paged_decode_kernel`: same
-    ``pages_per_block`` tunable, same bit-equal accumulation order."""
+    ``pages_per_block`` tunable, same bit-equal accumulation order, and
+    the same single ``quantized`` flag for the dequant-attend variant
+    (scale rows fused into the shared fold)."""
     pb = pages_per_block
-    k_refs = rest[:pb]
-    v_refs = rest[pb:2 * pb]
-    o_ref = rest[2 * pb]
-    m_scr, l_scr, acc_scr = rest[2 * pb + 1:]
+    (k_refs, v_refs, ks_refs, vs_refs, o_ref, m_scr, l_scr,
+     acc_scr) = _split_kv_refs(rest, pb, quantized)
     sl = pl.program_id(0)
     pj = pl.program_id(2)
     npg = pl.num_programs(2)
@@ -287,8 +411,10 @@ def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, *rest,
                 jnp.int32, (cc, page_size), 1)
             row = jax.lax.broadcasted_iota(jnp.int32, (cc, page_size), 0)
             ok = (tok <= start + row) & (row < nv)     # causal + live lane
-            _online_softmax_page_fold(q, k_refs[t], v_refs[t], ok,
-                                      m_scr, l_scr, acc_scr)
+            _online_softmax_page_fold(
+                q, k_refs[t], v_refs[t], ok, m_scr, l_scr, acc_scr,
+                k_scale=ks_refs[t][0, :] if quantized else None,
+                v_scale=vs_refs[t][0, :] if quantized else None)
 
     # ragged skip: blocks wholly past the chunk's live extent do nothing
     pl.when((nv > 0) & (pj * pb * page_size < start + nv))(_body)
@@ -303,15 +429,24 @@ def _paged_prefill_kernel(bt_ref, start_ref, nv_ref, q_ref, *rest,
 
 
 def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
-                          n_valid, scale, interpret, pages_per_block=1):
+                          n_valid, scale, interpret, pages_per_block=1,
+                          k_scales=None, v_scales=None):
+    """``k_scales``/``v_scales`` given = the dequant-attend variant
+    (same convention as :func:`_paged_decode_pallas`)."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("Pallas TPU backend unavailable; use impl='lax'")
+    quantized = k_scales is not None
     s_slots, c, h, dh = q.shape
     mp = block_tables.shape[1]
     ps = k_pages.shape[1]
     pb = max(1, min(int(pages_per_block), mp))
     qs = (q * jnp.asarray(scale, q.dtype))
     k_specs, v_specs = _paged_kv_specs(ps, dh, mp, pb)
+    sc_specs, sc_args = [], []
+    if quantized:
+        ks_specs, vs_specs = _paged_scale_specs(ps, mp, pb)
+        sc_specs = [*ks_specs, *vs_specs]
+        sc_args = [*([k_scales] * pb), *([v_scales] * pb)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # block_tables, chunk_starts, n_valid
@@ -321,6 +456,7 @@ def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
                          lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
             *k_specs,
             *v_specs,
+            *sc_specs,
         ],
         out_specs=pl.BlockSpec((1, c, 1, dh),
                                lambda s, hh, j, bt, st, nv: (s, 0, hh, 0)),
@@ -331,7 +467,7 @@ def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
         ],
     )
     kernel = functools.partial(_paged_prefill_kernel, page_size=ps,
-                               pages_per_block=pb)
+                               pages_per_block=pb, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -341,8 +477,57 @@ def _paged_prefill_pallas(q, k_pages, v_pages, block_tables, chunk_starts,
         ) if not interpret else None,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), chunk_starts.astype(jnp.int32),
-      n_valid.astype(jnp.int32), qs, *([k_pages] * pb), *([v_pages] * pb))
+      n_valid.astype(jnp.int32), qs, *([k_pages] * pb), *([v_pages] * pb),
+      *sc_args)
     return out
+
+
+# ---------------------------------------------------------------------------
+# int8 dequant-attend prefill
+# ---------------------------------------------------------------------------
+
+def _paged_prefill_int8_lax(q, k_pages, v_pages, k_scales, v_scales,
+                            block_tables, chunk_starts, n_valid, scale):
+    """Lax fallback of the dequant-attend prefill kernel (the int8 twin
+    of :func:`_paged_prefill_lax`; same scale-after-dot order as the
+    Pallas body, int8 pools barriered against constant folding)."""
+    from paddle_tpu import slim
+    k_pages = slim.int8_resident(k_pages)
+    v_pages = slim.int8_resident(v_pages)
+    s_slots, c, h, dh = q.shape
+    mp = block_tables.shape[1]
+    ps = k_pages.shape[1]
+    kg = k_pages[block_tables]                  # (S, mp, ps, H, Dh) int8
+    vg = v_pages[block_tables]
+    ksg = k_scales[block_tables]                # (S, mp, ps) f32
+    vsg = v_scales[block_tables]
+    scores = jnp.einsum("schd,smthd->shcmt", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    scores = scores * ksg[:, None, None]        # dequant fused post-dot
+    scores = scores.reshape(s_slots, h, c, mp * ps)
+    tok = jnp.arange(mp * ps, dtype=jnp.int32)
+    pos = chunk_starts[:, None] + jnp.arange(c, dtype=jnp.int32)  # (S, C)
+    causal = tok[None, None, None, :] <= pos[:, None, :, None]
+    row_ok = (jnp.arange(c) < n_valid[:, None])[:, None, :, None]
+    scores = jnp.where(causal & row_ok, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    alive = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF / 2
+    p = jnp.where(alive, p, 0.0).reshape(s_slots, h, c, mp, ps)
+    p = p * vsg[:, None, None]                  # dequant fused pre-PV
+    out = jnp.einsum("shcmt,smthd->schd", p, vg.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_prefill_int8_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                               block_tables, chunk_starts, n_valid,
+                               scale, interpret, pages_per_block=1):
+    """The dequant-attend prefill entry: the SAME kernel body as the fp
+    path with ``quantized=True`` (see :func:`_paged_decode_int8_pallas`
+    for the convention)."""
+    return _paged_prefill_pallas(q, k_pages, v_pages, block_tables,
+                                 chunk_starts, n_valid, scale, interpret,
+                                 pages_per_block=pages_per_block,
+                                 k_scales=k_scales, v_scales=v_scales)
 
 
 # ---------------------------------------------------------------------------
@@ -385,6 +570,41 @@ def ragged_paged_prefill_attention(q, k_pages, v_pages, block_tables,
     return kernels.dispatch("ragged_paged_prefill", q, k_pages, v_pages,
                             block_tables, chunk_starts, n_valid,
                             impl=impl, scale=scale)
+
+
+def ragged_paged_decode_int8_attention(q, k_pages, v_pages, k_scales,
+                                       v_scales, block_tables, lengths, *,
+                                       scale: Optional[float] = None,
+                                       impl: str = "auto"):
+    """Dequant-attend decode over an INT8 page pool (ISSUE 13).
+
+    Same contract as :func:`ragged_paged_decode_attention` with
+    ``k_pages``/``v_pages`` int8 and per-token-row fp32
+    ``k_scales``/``v_scales`` (P, page_size) — dequantization
+    (``q_int * scale``) is fused into the QK and PV products inside the
+    online-softmax page fold, so HBM moves int8 pages, never a
+    materialized fp copy. Returns (S, H, Dh) in ``q.dtype``.
+    """
+    from paddle_tpu import kernels
+    return kernels.dispatch("ragged_paged_decode_int8", q, k_pages,
+                            v_pages, k_scales, v_scales, block_tables,
+                            lengths, impl=impl, scale=scale)
+
+
+def ragged_paged_prefill_int8_attention(q, k_pages, v_pages, k_scales,
+                                        v_scales, block_tables,
+                                        chunk_starts, n_valid, *,
+                                        scale: Optional[float] = None,
+                                        impl: str = "auto"):
+    """Dequant-attend batched chunked prefill over an INT8 page pool —
+    the int8 twin of :func:`ragged_paged_prefill_attention` (and the
+    fixed-shape verify step speculative decoding rides on). Returns
+    (S, C, H, Dh) in ``q.dtype``.
+    """
+    from paddle_tpu import kernels
+    return kernels.dispatch("ragged_paged_prefill_int8", q, k_pages,
+                            v_pages, k_scales, v_scales, block_tables,
+                            chunk_starts, n_valid, impl=impl, scale=scale)
 
 
 def paged_prefill_attention(q, k_pages, v_pages, block_table_row,
@@ -599,6 +819,156 @@ def _prefill_donation_probe():
     return step, args, (0, 1)
 
 
+# -- int8 dequant-attend registry plumbing ----------------------------------
+
+def _decode_int8_kernel_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                               block_tables, lengths, *, block_sizes,
+                               interpret, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_decode_int8_pallas(
+        q, k_pages, v_pages, k_scales, v_scales, block_tables, lengths,
+        scale, interpret,
+        pages_per_block=block_sizes.get("pages_per_block", 1))
+
+
+def _decode_int8_kernel_lax(q, k_pages, v_pages, k_scales, v_scales,
+                            block_tables, lengths, *, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_decode_int8_lax(q, k_pages, v_pages, k_scales, v_scales,
+                                  block_tables, lengths, scale)
+
+
+def _dequant_pages_np(k_pages, v_pages, k_scales, v_scales):
+    """Host-side dequant for the dense references — independent of the
+    fused in-kernel path (the parity battery's whole point)."""
+    import numpy as np
+    kf = np.asarray(k_pages, np.float32) \
+        * np.asarray(k_scales, np.float32)[:, :, None, None]
+    vf = np.asarray(v_pages, np.float32) \
+        * np.asarray(v_scales, np.float32)[:, :, None, None]
+    return jnp.asarray(kf), jnp.asarray(vf)
+
+
+def _decode_int8_kernel_reference(q, k_pages, v_pages, k_scales, v_scales,
+                                  block_tables, lengths, *, scale=None):
+    kf, vf = _dequant_pages_np(k_pages, v_pages, k_scales, v_scales)
+    return _decode_kernel_reference(q, kf, vf, block_tables, lengths,
+                                    scale=scale)
+
+
+def _prefill_int8_kernel_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                                block_tables, chunk_starts, n_valid, *,
+                                block_sizes, interpret, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_prefill_int8_pallas(
+        q, k_pages, v_pages, k_scales, v_scales, block_tables,
+        chunk_starts, n_valid, scale, interpret,
+        pages_per_block=block_sizes.get("pages_per_block", 1))
+
+
+def _prefill_int8_kernel_lax(q, k_pages, v_pages, k_scales, v_scales,
+                             block_tables, chunk_starts, n_valid, *,
+                             scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _paged_prefill_int8_lax(q, k_pages, v_pages, k_scales,
+                                   v_scales, block_tables, chunk_starts,
+                                   n_valid, scale)
+
+
+def _prefill_int8_kernel_reference(q, k_pages, v_pages, k_scales,
+                                   v_scales, block_tables, chunk_starts,
+                                   n_valid, *, scale=None):
+    kf, vf = _dequant_pages_np(k_pages, v_pages, k_scales, v_scales)
+    return _prefill_kernel_reference(q, kf, vf, block_tables,
+                                     chunk_starts, n_valid, scale=scale)
+
+
+def _make_paged_int8_sample(seed, *, chunked):
+    """The fp sample's pages quantized per token row — THROUGH
+    :func:`paged_cache.quantize_kv` itself, so the registry's parity
+    and tuning samples can never drift from the convention the engine
+    actually stores."""
+    from paddle_tpu.serving.paged_cache import quantize_kv
+    args, kwargs = _make_paged_sample(seed, chunked=chunked)
+    q, k_pages, v_pages = args[0], args[1], args[2]
+    rest = args[3:]
+    kq, ks = quantize_kv(k_pages, (2, 3))          # scales (P, ps)
+    vq, vs = quantize_kv(v_pages, (2, 3))
+    return (q, kq, vq, ks, vs) + rest, kwargs
+
+
+def _paged_int8_tune_signature(args, kwargs):
+    q, k_pages, bt = args[0], args[1], args[5]
+    sig = [("s", q.shape[0]), ("h", k_pages.shape[2]),
+           ("d", q.shape[-1]), ("ps", k_pages.shape[1]),
+           ("mp", bt.shape[1])]
+    if q.ndim == 4:                      # prefill: chunk width matters
+        sig.insert(1, ("c", q.shape[1]))
+    return tuple(sig)
+
+
+def _paged_int8_vmem_estimate(args, kwargs, blocks):
+    q, k_pages = args[0], args[1]
+    ps, dh = k_pages.shape[1], k_pages.shape[-1]
+    c = q.shape[1] if q.ndim == 4 else 1
+    pb = blocks.get("pages_per_block", 1)
+    # int8 working set: pb (k, v) page pairs at 1 byte + their fp32
+    # scale rows + fp32 q/acc + m/l lane scratch + the score block
+    return (2 * pb * ps * dh + 4 * (2 * pb * ps + 2 * c * dh
+                                    + 2 * c * 128 + 2 * c * ps))
+
+
+def _decode_int8_donation_probe():
+    (q, k_pages, v_pages, k_scales, v_scales, block_tables, lengths), _ \
+        = _make_paged_int8_sample(0, chunked=False)
+
+    def step(kp, vp, ks, vs, q, bt, lens):
+        # the engine's real pattern: quantize this step's token K/V into
+        # the int8 pages + scale rows, attend THROUGH THE PALLAS BODY,
+        # hand all four buffers back (pages AND scales must alias)
+        from paddle_tpu.serving.paged_cache import quantize_kv
+        kq, ksc = quantize_kv(q[:1], (1, 2))
+        kp = kp.at[1, 0].set(kq[0])
+        vp = vp.at[1, 0].set(kq[0])
+        ks = ks.at[1, 0].set(ksc[0])
+        vs = vs.at[1, 0].set(ksc[0])
+        out = _decode_int8_kernel_pallas(
+            q, kp, vp, ks, vs, bt, lens,
+            block_sizes={"pages_per_block": 4}, interpret=True)
+        return out, kp, vp, ks, vs
+
+    args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in (k_pages, v_pages, k_scales, v_scales, q,
+                           block_tables, lengths))
+    return step, args, (0, 1, 2, 3)
+
+
+def _prefill_int8_donation_probe():
+    (q, k_pages, v_pages, k_scales, v_scales, block_tables, starts,
+     n_valid), _ = _make_paged_int8_sample(0, chunked=True)
+
+    def step(kp, vp, ks, vs, q, bt, st, nv):
+        from paddle_tpu.serving.paged_cache import quantize_kv
+        kq, ksc = quantize_kv(q[:1, 0], (1, 2))
+        kp = kp.at[1, 0].set(kq[0])
+        vp = vp.at[1, 0].set(kq[0])
+        ks = ks.at[1, 0].set(ksc[0])
+        vs = vs.at[1, 0].set(ksc[0])
+        out = _prefill_int8_kernel_pallas(
+            q, kp, vp, ks, vs, bt, st, nv,
+            block_sizes={"pages_per_block": 4}, interpret=True)
+        return out, kp, vp, ks, vs
+
+    args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in (k_pages, v_pages, k_scales, v_scales, q,
+                           block_tables, starts, n_valid))
+    return step, args, (0, 1, 2, 3)
+
+
 def _register_paged_kernels():
     from paddle_tpu import kernels
     pb_candidates = {"pages_per_block": (1, 2, 4)}
@@ -649,6 +1019,62 @@ def _register_paged_kernels():
         tune_signature=_paged_tune_signature,
         vmem_estimate=_paged_vmem_estimate,
         donation_probe=_prefill_donation_probe))
+    kernels.register(kernels.KernelSpec(
+        name="ragged_paged_decode_int8",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(S,H,Dh)", "k_pages": "(P,ps,H,Dh) i8",
+                         "v_pages": "(P,ps,H,Dh) i8",
+                         "k_scales": "(P,ps) f32",
+                         "v_scales": "(P,ps) f32",
+                         "block_tables": "(S,mp) i32",
+                         "lengths": "(S,) i32"},
+            out_layout="(S,H,Dh)",
+            donatable=("k_pages", "v_pages", "k_scales", "v_scales"),
+            grid="(S, H, cdiv(mp,pages_per_block)) block-table scalar "
+                 "prefetch, dead-page skip, scales fused into QK/PV",
+            block_candidates=pb_candidates,
+            atol=5e-5, rtol=5e-5),
+        pallas_fn=_decode_int8_kernel_pallas,
+        lax_fn=_decode_int8_kernel_lax,
+        reference_fn=_decode_int8_kernel_reference,
+        sample_inputs=lambda seed: _make_paged_int8_sample(seed,
+                                                           chunked=False),
+        # the int8 variant runs THROUGH the fp kernel's pallas_call site
+        # (one body, quantized=True) — no site of its own
+        pallas_sites=(
+            "paddle_tpu.serving.decode_attention:_paged_decode_pallas",),
+        tune_signature=_paged_int8_tune_signature,
+        vmem_estimate=_paged_int8_vmem_estimate,
+        donation_probe=_decode_int8_donation_probe))
+    kernels.register(kernels.KernelSpec(
+        name="ragged_paged_prefill_int8",
+        contract=kernels.KernelContract(
+            version=1,
+            arg_layouts={"q": "(S,C,H,Dh)", "k_pages": "(P,ps,H,Dh) i8",
+                         "v_pages": "(P,ps,H,Dh) i8",
+                         "k_scales": "(P,ps) f32",
+                         "v_scales": "(P,ps) f32",
+                         "block_tables": "(S,mp) i32",
+                         "chunk_starts": "(S,) i32",
+                         "n_valid": "(S,) i32"},
+            out_layout="(S,C,H,Dh)",
+            donatable=("k_pages", "v_pages", "k_scales", "v_scales"),
+            grid="(S, H, cdiv(mp,pages_per_block)) block-table scalar "
+                 "prefetch, causal + live-lane mask, scales fused into "
+                 "QK/PV",
+            block_candidates=pb_candidates,
+            atol=5e-5, rtol=5e-5),
+        pallas_fn=_prefill_int8_kernel_pallas,
+        lax_fn=_prefill_int8_kernel_lax,
+        reference_fn=_prefill_int8_kernel_reference,
+        sample_inputs=lambda seed: _make_paged_int8_sample(seed,
+                                                           chunked=True),
+        pallas_sites=(
+            "paddle_tpu.serving.decode_attention:_paged_prefill_pallas",),
+        tune_signature=_paged_int8_tune_signature,
+        vmem_estimate=_paged_int8_vmem_estimate,
+        donation_probe=_prefill_int8_donation_probe))
 
 
 _register_paged_kernels()

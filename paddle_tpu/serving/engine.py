@@ -35,6 +35,35 @@ shared tokens — see ``paged_cache``) and the engine performs the single
 copy-on-write page copy a borrowed *tail* page requires before the
 slot's first write.
 
+Int8 paged KV (ISSUE 13): ``cache_dtype=jnp.int8`` stores the page
+pool quantized with per-token-row fp32 scales (``paged_cache``) —
+roughly half the HBM per live token of bf16, so the same pool hosts
+~2x the slots — and both fixed-shape steps write int8 rows + scales
+and attend through the **dequant-attend** kernel variants (scales
+fused into the QK/PV products inside the online-softmax page stream;
+no fp page materialized). The PR 7 cost model proves the bytes
+reduction statically (`tools/cost_budgets.json` gates it in CI), and
+migration shards carry page + scales under one hash.
+
+Speculative decoding (ISSUE 13): pass ``draft_model``/``draft_params``
+(+ ``spec_k``) and the decode phase becomes draft-then-verify: the
+draft proposes ``spec_k`` greedy tokens per slot on its OWN paged
+cache (same slot/page geometry, allocations in lockstep), and the
+target verifies the whole chunk in ONE fixed-shape batched-prefill-
+shaped step (`_verify_step_impl` — per-position greedy argmax). Each
+round accepts the longest draft prefix the target agrees with plus the
+target's next token, so **greedy outputs are bit-exact vs
+non-speculative decoding**; rollback is a host-side cursor rewind
+(rejected tokens' K/V stay masked behind the slot length and are
+overwritten — pages were reserved up front, nothing leaks). Accept
+quality lands in ``serving_spec_accept_rate`` /
+``serving_spec_proposed_total`` / ``serving_spec_accepted_total`` and
+per-request ``request_stats``; ``warmup()`` precompiles the draft /
+draft-prefill / verify buckets so steady state still compiles nothing
+(bucket-coverage lint proves it ahead of time). Speculation disables
+prefix sharing (the draft must prefill every prompt token) and slot
+migration (the draft cache is not carried in snapshots).
+
 Scheduling is SLO-aware by default (``scheduler_policy="slo"``):
 priority lanes, TTFT deadlines with earliest-deadline-first boosting,
 no head-of-line blocking (bounded-skip anti-starvation), and load
@@ -80,7 +109,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.serving import decode_attention as DA
-from paddle_tpu.serving.paged_cache import PagedCacheConfig, PagedKVCache
+from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
+                                            quantize_kv)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Reject, Request, SLOScheduler,
                                           SlotState)
@@ -128,7 +158,9 @@ class ServingEngine:
                  starvation_skips: int = 64,
                  registry=None, tracer=None,
                  ttft_budget_s: Optional[float] = None,
-                 slo_windows=(60.0, 300.0)):
+                 slo_windows=(60.0, 300.0),
+                 draft_model=None, draft_params=None, spec_k: int = 4,
+                 draft_cache_dtype=None):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
@@ -139,6 +171,28 @@ class ServingEngine:
         self.attn_impl = attn_impl
         self.prefill_chunk = int(prefill_chunk)
         self.decode_block = max(int(decode_block), 1)
+        # -- speculative decoding (ISSUE 13): a draft model proposes
+        # spec_k tokens per slot per round; the target verifies them all
+        # in ONE fixed-shape batched-prefill-shaped step
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.speculative = draft_model is not None
+        self.spec_k = int(spec_k)
+        if self.speculative:
+            if draft_params is None:
+                raise ValueError("draft_model needs draft_params")
+            if draft_model.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocabulary "
+                    f"({draft_model.cfg.vocab_size} != {cfg.vocab_size})")
+            if self.spec_k < 2:
+                raise ValueError("spec_k must be >= 2 (spec_k=1 is "
+                                 "plain decoding — drop the draft)")
+            # the draft cache must hold EVERY prompt token (the draft
+            # prefills alongside the target), so target-side prefix
+            # sharing — which skips prefilling shared tokens — would
+            # desynchronize the two caches; speculation disables it
+            prefix_sharing = False
         # prefill/decode interleaving budget: prompt tokens per step()
         # (default = one full batched call across every slot)
         self.prefill_budget = int(prefill_budget or
@@ -151,7 +205,10 @@ class ServingEngine:
             # DOWN to bet on early EOS (that is the paging win)
             num_pages = num_slots * max_pages_per_slot + 1
         # like generate(cache_dtype=...): a bf16 page pool halves KV
-        # gather traffic (softmax still runs fp32 inside the kernel)
+        # gather traffic (softmax still runs fp32 inside the kernel);
+        # cache_dtype=jnp.int8 stores quantized pages with per-token-row
+        # fp32 scales and attends through the dequant-attend kernels —
+        # HBM per live token roughly halves AGAIN vs bf16
         dtype = cache_dtype or params["wte"]["weight"].dtype
         self.cache = PagedKVCache(PagedCacheConfig(
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
@@ -159,6 +216,24 @@ class ServingEngine:
             num_slots=num_slots, page_size=page_size, num_pages=num_pages,
             max_pages_per_slot=max_pages_per_slot, dtype=dtype,
             share_prefix=prefix_sharing))
+        self.quantized = self.cache.config.quantized
+        self.draft_cache = None
+        self._draft_quantized = False
+        if self.speculative:
+            dcfg = draft_model.cfg
+            ddtype = draft_cache_dtype or cache_dtype or \
+                draft_params["wte"]["weight"].dtype
+            # same slot/page geometry as the target cache: allocations
+            # run in lockstep (reserve/free the same slots for the same
+            # token counts), so target admission implies draft admission
+            self.draft_cache = PagedKVCache(PagedCacheConfig(
+                num_layers=dcfg.num_layers, num_heads=dcfg.num_heads,
+                head_dim=dcfg.hidden_size // dcfg.num_heads,
+                num_slots=num_slots, page_size=page_size,
+                num_pages=num_pages,
+                max_pages_per_slot=max_pages_per_slot, dtype=ddtype,
+                share_prefix=False))
+            self._draft_quantized = self.draft_cache.config.quantized
         if scheduler_policy == "slo":
             self.scheduler = SLOScheduler(
                 num_slots, can_admit=self._can_admit, lanes=lanes,
@@ -200,6 +275,15 @@ class ServingEngine:
                                    donate_argnums=(1,))
         self.prefill_step = jax.jit(self._prefill_step_impl,
                                     donate_argnums=(1,))
+        if self.speculative:
+            # draft pages donate into their own steps; the verify step
+            # donates the TARGET pages exactly like prefill does
+            self.draft_prefill_step = jax.jit(
+                self._draft_prefill_step_impl, donate_argnums=(1,))
+            self.draft_propose_step = jax.jit(
+                self._draft_propose_step_impl, donate_argnums=(1,))
+            self.verify_step = jax.jit(self._verify_step_impl,
+                                       donate_argnums=(1,))
         self.copy_page_step = jax.jit(self._copy_page_impl,
                                       donate_argnums=(0,))
         # migration page IO (fleet drain): src/dst are traced scalars,
@@ -285,7 +369,9 @@ class ServingEngine:
         self._phase_acc[rid] = {"prefill_s": 0.0, "decode_s": 0.0,
                                 "prefill_chunks": 0.0,
                                 "decode_blocks": 0.0,
-                                "shared_tokens": 0.0}
+                                "shared_tokens": 0.0,
+                                "spec_proposed": 0.0,
+                                "spec_accepted": 0.0}
         if trace_id is not None:
             self._ext_trace[rid] = int(trace_id)
         if self.tracer.enabled:
@@ -427,56 +513,10 @@ class ServingEngine:
             self._reg.gauge("serving_page_utilization",
                             "live tokens / page-pool capacity").set(
                                 self.cache.utilization())
-            n = self.decode_block
-            s_tot = self.scheduler.num_slots
-            tokens = np.zeros((s_tot,), np.int32)
-            active = np.zeros((s_tot,), np.int32)
-            for i in dslots:
-                tokens[i] = self.scheduler.slots[i].generated[-1]
-                active[i] = 1
-            w = self._pow2_width(max(
-                self.cache.config.pages_for(
-                    int(self.cache.lengths[i]) + n) for i in dslots))
-            t0 = time.monotonic()
-            out, self.cache.pages = self.decode_step(
-                self.params, self.cache.pages,
-                jnp.asarray(self.cache.block_tables[:, :w]),
-                jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
-                jnp.asarray(active))
-            out = np.asarray(out)                    # (S, decode_block)
-            t1 = time.monotonic()
-            self._reg.histogram(
-                "serving_decode_step_seconds",
-                "wall time per decode block (sync included)").observe(
-                    t1 - t0)
-            tr_on = self.tracer.enabled
-            kept = 0
-            for i in dslots:
-                st = self.scheduler.slots[i]
-                req = st.request
-                budget_i = req.max_new_tokens - len(st.generated)
-                kept_i = 0
-                for j in range(min(n, budget_i)):
-                    tok = int(out[i, j])
-                    st.generated.append(tok)
-                    kept_i += 1
-                    if req.eos_id is not None and tok == req.eos_id:
-                        break
-                kept += kept_i
-                if not st.finished():
-                    # device advanced this slot the full block
-                    self.cache.lengths[i] += n
-                acc = self._phase_acc.get(req.rid)
-                if acc is not None:
-                    acc["decode_s"] += t1 - t0
-                    acc["decode_blocks"] += 1
-                if tr_on:
-                    # lanes run in the same batched call, so the spans
-                    # share the interval — a parallel track per request
-                    self.tracer.record_span(
-                        "serving.decode_block", start=t0, end=t1,
-                        parent=self._req_spans.get(req.rid),
-                        slot=i, tokens=kept_i)
+            if self.speculative:
+                kept = self._speculative_round(dslots)
+            else:
+                kept = self._decode_round(dslots)
             self._reg.counter("serving_tokens_total",
                               "decode tokens produced").inc(kept)
             self._reg.counter("serving_steps_total").inc()
@@ -487,6 +527,166 @@ class ServingEngine:
             self.slo_monitor.check()
         self._refresh_health()
         return finished
+
+    def _decode_round(self, dslots) -> int:
+        """Advance every decoding slot one block of ``decode_block``
+        tokens through the jitted decode step; returns tokens kept."""
+        n = self.decode_block
+        s_tot = self.scheduler.num_slots
+        tokens = np.zeros((s_tot,), np.int32)
+        active = np.zeros((s_tot,), np.int32)
+        for i in dslots:
+            tokens[i] = self.scheduler.slots[i].generated[-1]
+            active[i] = 1
+        w = self._pow2_width(max(
+            self.cache.config.pages_for(
+                int(self.cache.lengths[i]) + n) for i in dslots))
+        t0 = time.monotonic()
+        out, self.cache.pages = self.decode_step(
+            self.params, self.cache.pages,
+            jnp.asarray(self.cache.block_tables[:, :w]),
+            jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
+            jnp.asarray(active))
+        out = np.asarray(out)                    # (S, decode_block)
+        t1 = time.monotonic()
+        self._reg.histogram(
+            "serving_decode_step_seconds",
+            "wall time per decode block (sync included)").observe(
+                t1 - t0)
+        tr_on = self.tracer.enabled
+        kept = 0
+        for i in dslots:
+            st = self.scheduler.slots[i]
+            req = st.request
+            budget_i = req.max_new_tokens - len(st.generated)
+            kept_i = 0
+            for j in range(min(n, budget_i)):
+                tok = int(out[i, j])
+                st.generated.append(tok)
+                kept_i += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    break
+            kept += kept_i
+            if not st.finished():
+                # device advanced this slot the full block
+                self.cache.lengths[i] += n
+            acc = self._phase_acc.get(req.rid)
+            if acc is not None:
+                acc["decode_s"] += t1 - t0
+                acc["decode_blocks"] += 1
+            if tr_on:
+                # lanes run in the same batched call, so the spans
+                # share the interval — a parallel track per request
+                self.tracer.record_span(
+                    "serving.decode_block", start=t0, end=t1,
+                    parent=self._req_spans.get(req.rid),
+                    slot=i, tokens=kept_i)
+        return kept
+
+    def _speculative_round(self, dslots) -> int:
+        """One speculative decode round (ISSUE 13): the draft model
+        proposes ``spec_k`` greedy tokens per slot on its own paged
+        cache, the target verifies the whole chunk ``[pending, d_1 ..
+        d_{k-1}]`` in ONE fixed-shape batched-prefill-shaped step
+        (per-position greedy argmax), and each slot accepts the longest
+        prefix of draft tokens the target agrees with PLUS the target's
+        own next token — so every accepted token is exactly what
+        non-speculative greedy decoding would have produced (the
+        bit-exactness gate), and each round yields 1..spec_k tokens.
+
+        Rollback is a host-side cursor rewind: both caches advance
+        their write cursors by only the accepted inputs; rejected
+        tokens' K/V stay behind the slot length (masked as dead by the
+        ragged kernels, overwritten by the next round) and their pages
+        were part of the slot's up-front all-or-nothing reservation, so
+        nothing leaks. Returns tokens kept."""
+        n = self.spec_k
+        s_tot = self.scheduler.num_slots
+        tokens = np.zeros((s_tot,), np.int32)
+        active = np.zeros((s_tot,), np.int32)
+        nv = np.zeros((s_tot,), np.int32)
+        for i in dslots:
+            st = self.scheduler.slots[i]
+            tokens[i] = st.generated[-1]
+            active[i] = 1
+            # never write past the slot's reservation: the chunk is
+            # capped at the remaining generation budget
+            nv[i] = min(n, st.request.max_new_tokens - len(st.generated))
+        w = self._pow2_width(max(
+            self.cache.config.pages_for(
+                int(self.cache.lengths[i]) + n) for i in dslots))
+        t0 = time.monotonic()
+        nv_dev = jnp.asarray(nv)
+        props_dev, self.draft_cache.pages = self.draft_propose_step(
+            self.draft_params, self.draft_cache.pages,
+            jnp.asarray(self.draft_cache.block_tables[:, :w]),
+            jnp.asarray(self.draft_cache.lengths), jnp.asarray(tokens),
+            jnp.asarray(active), nv_dev)
+        # verify dispatches on the UN-materialized proposals (the chunk
+        # is assembled inside the jitted step), so the draft->verify
+        # chain never blocks on a host round-trip; the props transfer
+        # below overlaps the verify compute
+        ver, self.cache.pages = self.verify_step(
+            self.params, self.cache.pages,
+            jnp.asarray(self.cache.block_tables[:, :w]),
+            jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
+            props_dev, nv_dev)
+        props = np.asarray(props_dev)          # (S, spec_k) proposals
+        ver = np.asarray(ver)                  # (S, spec_k) target greedy
+        t1 = time.monotonic()
+        self._reg.histogram(
+            "serving_decode_step_seconds",
+            "wall time per decode block (sync included)").observe(
+                t1 - t0)
+        tr_on = self.tracer.enabled
+        kept = 0
+        for i in dslots:
+            st = self.scheduler.slots[i]
+            req = st.request
+            c = int(nv[i])
+            # accept: t_1, plus t_{j+1} for every draft token d_j the
+            # target reproduced — the canonical greedy accept-prefix
+            a = 1
+            while a < c and props[i, a - 1] == ver[i, a - 1]:
+                a += 1
+            kept_i = 0
+            for j in range(a):
+                tok = int(ver[i, j])
+                st.generated.append(tok)
+                kept_i += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    break
+            kept += kept_i
+            if not st.finished():
+                # commit exactly the accepted inputs on BOTH caches;
+                # the rejected tail is rewound by simply not advancing
+                self.cache.lengths[i] += a
+                self.draft_cache.lengths[i] += a
+            proposed, accepted = max(c - 1, 0), a - 1
+            self._reg.counter(
+                "serving_spec_proposed_total",
+                "draft tokens proposed for verification").inc(proposed)
+            self._reg.counter(
+                "serving_spec_accepted_total",
+                "draft tokens the target verified and kept").inc(accepted)
+            if proposed:
+                self._reg.histogram(
+                    "serving_spec_accept_rate",
+                    "accepted/proposed draft tokens per verify round",
+                    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                             0.875, 1.0)).observe(accepted / proposed)
+            acc = self._phase_acc.get(req.rid)
+            if acc is not None:
+                acc["decode_s"] += t1 - t0
+                acc["decode_blocks"] += 1
+                acc["spec_proposed"] += proposed
+                acc["spec_accepted"] += accepted
+            if tr_on:
+                self.tracer.record_span(
+                    "serving.verify_block", start=t0, end=t1,
+                    parent=self._req_spans.get(req.rid), slot=i,
+                    tokens=kept_i, proposed=proposed, accepted=accepted)
+        return kept
 
     def generate_many(self, prompts: Sequence, max_new_tokens: int = 32,
                       eos_id: Optional[int] = None,
@@ -509,6 +709,8 @@ class ServingEngine:
         out = {}
         for slot, st in self.scheduler.evict_finished().items():
             self.cache.free_slot(slot)
+            if self.speculative:
+                self.draft_cache.free_slot(slot)
             toks = np.asarray(st.generated, np.int32)
             req = st.request
             self._results[req.rid] = toks
@@ -529,6 +731,8 @@ class ServingEngine:
                 "prefill_chunks": acc.get("prefill_chunks", 0.0),
                 "decode_blocks": acc.get("decode_blocks", 0.0),
                 "shared_tokens": acc.get("shared_tokens", 0.0),
+                "spec_proposed": acc.get("spec_proposed", 0.0),
+                "spec_accepted": acc.get("spec_accepted", 0.0),
                 "tokens": float(len(st.generated)),
                 "trace_id": float(root.trace_id) if root is not None
                 else float(self._ext_trace.pop(req.rid, 0)),
@@ -555,6 +759,12 @@ class ServingEngine:
         tokens, and record the queue-wait half of the TTFT split."""
         shared = self.cache.reserve(slot, req.total_tokens,
                                     prompt=req.prompt)
+        if self.speculative:
+            # lockstep reservation: same geometry + same alloc/free
+            # history as the target cache, so this cannot overflow when
+            # the target reserve succeeded (sharing is off — the draft
+            # prefills the whole prompt, so nothing is skipped)
+            self.draft_cache.reserve(slot, req.total_tokens)
         st = self.scheduler.slots[slot]
         st.prefilled = shared
         if shared:
@@ -622,6 +832,7 @@ class ServingEngine:
             starts = np.zeros((sb,), np.int32)
             nv = np.zeros((sb,), np.int32)
             bt_rows = np.zeros((sb, cfgc.max_pages_per_slot), np.int32)
+            dbt_rows = np.zeros_like(bt_rows) if self.speculative else None
             for j, i in enumerate(pslots):
                 st = self.scheduler.slots[i]
                 pc = self.cache.pending_copy(i)
@@ -653,6 +864,8 @@ class ServingEngine:
                 starts[j] = lo
                 nv[j] = n
                 bt_rows[j] = self.cache.block_tables[i]
+                if self.speculative:
+                    dbt_rows[j] = self.draft_cache.block_tables[i]
             w = self._pow2_width(max(
                 cfgc.pages_for(int(starts[j]) + int(nv[j]))
                 for j in range(len(pslots))))
@@ -661,6 +874,15 @@ class ServingEngine:
                 self.params, self.cache.pages,
                 jnp.asarray(bt_rows[:, :w]),
                 jnp.asarray(starts), jnp.asarray(tokens), jnp.asarray(nv))
+            if self.speculative:
+                # the draft cache ingests the SAME chunks so its pages
+                # mirror the target's committed prefix (its next-token
+                # output is discarded — proposals start at decode time)
+                _, self.draft_cache.pages = self.draft_prefill_step(
+                    self.draft_params, self.draft_cache.pages,
+                    jnp.asarray(dbt_rows[:, :w]),
+                    jnp.asarray(starts), jnp.asarray(tokens),
+                    jnp.asarray(nv))
             nxt = np.asarray(nxt)
             now = time.monotonic()
             self._reg.histogram(
@@ -675,6 +897,8 @@ class ServingEngine:
                 n = int(nv[j])
                 st.prefilled += n
                 self.cache.lengths[i] += n
+                if self.speculative:
+                    self.draft_cache.lengths[i] += n
                 call_tokens += n
                 self.cache.publish_prefix(i, st.request.prompt,
                                           st.prefilled)
@@ -734,8 +958,12 @@ class ServingEngine:
     def warmup_plan(self):
         """The signatures ``warmup()`` precompiles, in compile order:
         ``("decode", width)``, ``("prefill", width, lanes)``, and
-        ``("copy_page",)``. Derived from the warmup-side doubling loops
-        — :func:`~paddle_tpu.analysis.hlo_lint.serving_bucket_coverage`
+        ``("copy_page",)`` — a speculative engine swaps the decode
+        buckets for ``("draft", width)`` + ``("verify", width)`` and
+        adds the draft's ``("draft_prefill", width, lanes)`` twins (the
+        verify/draft buckets are part of the coverage proof like any
+        other). Derived from the warmup-side doubling loops —
+        :func:`~paddle_tpu.analysis.hlo_lint.serving_bucket_coverage`
         proves this plan covers :meth:`reachable_signatures`, turning
         the runtime zero-recompile invariant into an ahead-of-time
         proof."""
@@ -755,9 +983,15 @@ class ServingEngine:
         counts = sorted(set(counts))
         plan = []
         for w in widths:
-            plan.append(("decode", w))
+            if self.speculative:
+                plan.append(("draft", w))
+                plan.append(("verify", w))
+            else:
+                plan.append(("decode", w))
             for sb in counts:
                 plan.append(("prefill", w, sb))
+                if self.speculative:
+                    plan.append(("draft_prefill", w, sb))
         plan.append(("copy_page",))
         # migration page IO: scalar-indexed, so one signature each
         # covers every page a fleet drain ever reads or writes
@@ -770,13 +1004,21 @@ class ServingEngine:
         request, enumerated from the STEP-side bucketing functions
         (``_pow2_width`` over every possible live page count,
         ``_pow2_count`` over every in-prefill slot count) — the other
-        half of the bucket-coverage proof."""
+        half of the bucket-coverage proof. A speculative engine's
+        decode phase requests draft + verify buckets instead of decode
+        buckets, plus the draft-prefill twins."""
         c = self.cache.config
         widths = {self._pow2_width(n)
                   for n in range(1, c.max_pages_per_slot + 1)}
         counts = {self._pow2_count(n)
                   for n in range(1, self.scheduler.num_slots + 1)}
-        sigs = {("decode", w) for w in widths}
+        if self.speculative:
+            sigs = {("draft", w) for w in widths}
+            sigs |= {("verify", w) for w in widths}
+            sigs |= {("draft_prefill", w, sb)
+                     for w in widths for sb in counts}
+        else:
+            sigs = {("decode", w) for w in widths}
         sigs |= {("prefill", w, sb) for w in widths for sb in counts}
         sigs.add(("copy_page",))
         sigs.add(("page_read",))
@@ -810,6 +1052,24 @@ class ServingEngine:
                 if cost_gauges:
                     self._bucket_cost_gauges(sig, self.decode_step, args)
                 _, self.cache.pages = self.decode_step(*args)
+            elif sig[0] == "draft":
+                w = sig[1]
+                args = (self.draft_params, self.draft_cache.pages,
+                        jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
+                        zeros, zeros)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.draft_propose_step,
+                                             args)
+                _, self.draft_cache.pages = self.draft_propose_step(*args)
+            elif sig[0] == "verify":
+                w = sig[1]
+                args = (self.params, self.cache.pages,
+                        jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
+                        jnp.zeros((s_tot, self.spec_k), jnp.int32),
+                        zeros)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.verify_step, args)
+                _, self.cache.pages = self.verify_step(*args)
             elif sig[0] == "prefill":
                 w, sb = sig[1], sig[2]
                 zb = jnp.zeros((sb,), jnp.int32)
@@ -820,15 +1080,35 @@ class ServingEngine:
                 if cost_gauges:
                     self._bucket_cost_gauges(sig, self.prefill_step, args)
                 _, self.cache.pages = self.prefill_step(*args)
+            elif sig[0] == "draft_prefill":
+                w, sb = sig[1], sig[2]
+                zb = jnp.zeros((sb,), jnp.int32)
+                args = (self.draft_params, self.draft_cache.pages,
+                        jnp.zeros((sb, w), jnp.int32), zb,
+                        jnp.zeros((sb, self.prefill_chunk), jnp.int32),
+                        zb)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.draft_prefill_step,
+                                             args)
+                _, self.draft_cache.pages = self.draft_prefill_step(*args)
             elif sig[0] == "page_read":
-                np.asarray(self.read_page_step(
+                jax.block_until_ready(self.read_page_step(
                     self.cache.pages, jnp.asarray(0, jnp.int32)))
             elif sig[0] == "page_write":
                 c = self.cache.config
                 blank = jnp.zeros((2, c.num_layers, c.page_size,
-                                   c.num_heads, c.head_dim), c.dtype)
-                self.cache.pages = self.write_page_step(
-                    self.cache.pages, jnp.asarray(0, jnp.int32), blank)
+                                   c.num_heads, c.head_dim),
+                                  jnp.int8 if self.quantized else c.dtype)
+                if self.quantized:
+                    blank_sc = jnp.zeros((2, c.num_layers, c.page_size),
+                                         jnp.float32)
+                    self.cache.pages = self.write_page_step(
+                        self.cache.pages, jnp.asarray(0, jnp.int32),
+                        blank, blank_sc)
+                else:
+                    self.cache.pages = self.write_page_step(
+                        self.cache.pages, jnp.asarray(0, jnp.int32),
+                        blank)
             else:
                 self.cache.pages = self.copy_page_step(
                     self.cache.pages, jnp.asarray(0, jnp.int32),
@@ -869,7 +1149,13 @@ class ServingEngine:
         pair with :meth:`release_slot` to actually drain it. A pending
         copy-on-write tail reads THROUGH to its source page (the dst
         has not been copied yet), so the snapshot always carries the
-        logical KV content."""
+        logical KV content. An int8 cache's shards carry the pages'
+        scale rows alongside the int8 KV — ONE shard, one hash over
+        both, so a transfer can never split a page from its scales."""
+        if self.speculative:
+            raise SlotMigrationError(
+                "speculative engines do not migrate slots (the draft "
+                "cache state is not carried in a snapshot)")
         st = self.scheduler.slots[slot]
         if st is None:
             raise SlotMigrationError(f"slot {slot} is empty")
@@ -884,13 +1170,17 @@ class ServingEngine:
             pids = [src if p == dst else p for p in pids]
         shards, manifest = [], []
         for k, pid in enumerate(pids):
-            kv = np.asarray(self.read_page_step(
-                self.cache.pages, jnp.asarray(pid, jnp.int32)))
-            shards.append(kv)
+            page = self.read_page_step(self.cache.pages,
+                                       jnp.asarray(pid, jnp.int32))
+            if self.quantized:
+                shard = (np.asarray(page[0]), np.asarray(page[1]))
+            else:
+                shard = np.asarray(page)
+            shards.append(shard)
             manifest.append({
                 "index": k,
-                "sha256": hashlib.sha256(kv.tobytes()).hexdigest(),
-                "bytes": kv.nbytes,
+                "sha256": self._shard_digest(shard),
+                "bytes": self._shard_bytes(shard),
             })
         root = self._req_spans.get(req.rid)
         trace_id = (root.trace_id if root is not None
@@ -918,6 +1208,23 @@ class ServingEngine:
             "shards": shards,
             "manifest": manifest,
         }
+
+    def _shard_digest(self, shard) -> str:
+        """sha256 of one migration shard — a quantized shard hashes the
+        int8 KV AND its scale rows as one digest (a scale-only
+        corruption is as fatal as a KV corruption and must be refused
+        the same way)."""
+        if self.quantized:
+            kv, sc = shard
+            h = hashlib.sha256(np.asarray(kv).tobytes())
+            h.update(np.asarray(sc).tobytes())
+            return h.hexdigest()
+        return hashlib.sha256(np.asarray(shard).tobytes()).hexdigest()
+
+    def _shard_bytes(self, shard) -> int:
+        if self.quantized:
+            return int(shard[0].nbytes + shard[1].nbytes)
+        return int(shard.nbytes)
 
     def cancel_queued(self) -> List[Request]:
         """Pop every queued (not yet admitted) request and close its
@@ -952,6 +1259,8 @@ class ServingEngine:
             raise SlotMigrationError(f"slot {slot} is empty")
         self.scheduler.slots[slot] = None
         self.cache.free_slot(slot)
+        if self.speculative:
+            self.draft_cache.free_slot(slot)
         rid = st.request.rid
         self._phase_acc.pop(rid, None)
         self._ext_trace.pop(rid, None)
@@ -979,6 +1288,10 @@ class ServingEngine:
         root span adopts the snapshot's ``trace_id`` (under
         ``parent_span`` when given), keeping one timeline across the
         migration."""
+        if self.speculative:
+            raise SlotMigrationError(
+                "speculative engines do not migrate slots (the draft "
+                "cache state is not carried in a snapshot)")
         if snap.get("format") != MIGRATION_FORMAT:
             raise SlotMigrationError(
                 f"unknown snapshot format {snap.get('format')!r}")
@@ -994,8 +1307,8 @@ class ServingEngine:
         if len(shards) != len(manifest):
             raise SlotMigrationError(
                 f"{len(shards)} shards != {len(manifest)} manifest entries")
-        for kv, rec in zip(shards, manifest):
-            digest = hashlib.sha256(np.asarray(kv).tobytes()).hexdigest()
+        for shard, rec in zip(shards, manifest):
+            digest = self._shard_digest(shard)
             if digest != rec["sha256"]:
                 raise SlotMigrationError(
                     f"shard {rec['index']} sha256 mismatch "
@@ -1026,11 +1339,17 @@ class ServingEngine:
         # carried KV into every live page, so the slot must own them all
         self.cache.reserve(slot, total)
         stt = snap["state"]
-        for k, kv in enumerate(shards):
+        for k, shard in enumerate(shards):
             dst = int(self.cache.block_tables[slot, k])
-            self.cache.pages = self.write_page_step(
-                self.cache.pages, jnp.asarray(dst, jnp.int32),
-                jnp.asarray(kv))
+            if self.quantized:
+                kv, sc = shard
+                self.cache.pages = self.write_page_step(
+                    self.cache.pages, jnp.asarray(dst, jnp.int32),
+                    jnp.asarray(kv), jnp.asarray(sc))
+            else:
+                self.cache.pages = self.write_page_step(
+                    self.cache.pages, jnp.asarray(dst, jnp.int32),
+                    jnp.asarray(shard))
         self.cache.lengths[slot] = int(stt["length"])
         rid = next(self.scheduler._ids)     # fresh local rid, no collision
         req = Request(rid, prompt, int(rq["max_new_tokens"]),
@@ -1067,31 +1386,37 @@ class ServingEngine:
 
     # -- jitted step bodies ----------------------------------------------
 
-    def _decode_step_impl(self, params, pages, block_tables, lengths,
-                          tokens, active):
-        """Fixed-shape batched decode of ONE BLOCK of ``decode_block``
-        tokens per slot: each inner iteration enters every slot's
-        current token at position ``lengths[s]``, lands its K/V in the
-        slot's current page, and attends ragged-paged over live pages
-        only — one host round-trip per block instead of per token.
-        Non-decoding lanes (``active == 0``: free slots AND slots still
-        mid-prefill, which own live pages the block must not corrupt)
-        write to the null page; post-EOS/post-cap lanes write past their
-        reservation into the null page and produce discarded garbage
-        (the host keeps only in-budget, pre-EOS tokens).
-        Returns (tokens (S, decode_block), pages)."""
-        model, cfg = self.model, self.model.cfg
+    def _decode_loop(self, params, pages, block_tables, lengths, tokens,
+                     active, n_valid=None, *, model=None, quantized=False,
+                     n_steps=1):
+        """The shared greedy token loop behind the decode step AND the
+        draft-proposal step: ``n_steps`` inner iterations, each entering
+        every slot's current token at position ``lengths[s]``, landing
+        its K/V in the slot's current page (quantized caches store the
+        int8 rows + per-token scales and attend through the
+        dequant-attend kernel), and attending ragged-paged over live
+        pages only. ``n_valid`` (draft proposing) additionally masks
+        writes of iterations ``j >= n_valid[s]`` to the null page — a
+        chunk capped below ``n_steps`` must not write past the slot's
+        reservation. The keyword-only args are static config (default-
+        marked so the AST host-sync lint, which runs on THIS body via
+        the graph_lint preset, seeds only the array args as tracers).
+        Returns (tokens (S, n_steps), pages)."""
+        cfg = model.cfg
         ps = self.cache.config.page_size
         s_tot = tokens.shape[0]
         w = block_tables.shape[1]
         slot_ids = jnp.arange(s_tot)
 
-        def one_token(pages, lengths, tokens):
+        def one_token(j, pages, lengths, tokens):
             pos = jnp.minimum(lengths, cfg.max_position - 1)
             x = (model.wte(params["wte"], tokens[:, None])
                  + model.wpe(params["wpe"], pos[:, None]))      # (S,1,D)
+            writable = active > 0
+            if n_valid is not None:
+                writable = writable & (j < n_valid)
             page_idx = jnp.where(
-                active > 0,
+                writable,
                 block_tables[slot_ids, jnp.minimum(lengths // ps, w - 1)],
                 0)
             off = lengths % ps
@@ -1100,46 +1425,92 @@ class ServingEngine:
                 bp = params["blocks"][str(i)]
                 h = block.ln1(bp["ln1"], x)
                 q, k, v = block.attn.qkv_heads(bp["attn"], h)   # (S,H,1,Dh)
-                kp, vp = pages[i]
-                kp = kp.at[page_idx, off].set(
-                    k[:, :, 0, :].astype(kp.dtype))
-                vp = vp.at[page_idx, off].set(
-                    v[:, :, 0, :].astype(vp.dtype))
-                att = DA.ragged_paged_decode_attention(
-                    q[:, :, 0, :], kp, vp, block_tables, lengths + 1,
-                    impl=self.attn_impl)                        # (S,H,Dh)
+                if quantized:
+                    kp, vp, ksc, vsc = pages[i]
+                    kq, k_s = quantize_kv(k[:, :, 0, :], (1, 2))
+                    vq, v_s = quantize_kv(v[:, :, 0, :], (1, 2))
+                    kp = kp.at[page_idx, off].set(kq)
+                    vp = vp.at[page_idx, off].set(vq)
+                    ksc = ksc.at[page_idx, off].set(k_s)
+                    vsc = vsc.at[page_idx, off].set(v_s)
+                    att = DA.ragged_paged_decode_int8_attention(
+                        q[:, :, 0, :], kp, vp, ksc, vsc, block_tables,
+                        lengths + 1, impl=self.attn_impl)       # (S,H,Dh)
+                    new_pages.append((kp, vp, ksc, vsc))
+                else:
+                    kp, vp = pages[i]
+                    kp = kp.at[page_idx, off].set(
+                        k[:, :, 0, :].astype(kp.dtype))
+                    vp = vp.at[page_idx, off].set(
+                        v[:, :, 0, :].astype(vp.dtype))
+                    att = DA.ragged_paged_decode_attention(
+                        q[:, :, 0, :], kp, vp, block_tables, lengths + 1,
+                        impl=self.attn_impl)                    # (S,H,Dh)
+                    new_pages.append((kp, vp))
                 x = x + block.attn.proj_out(bp["attn"],
                                             att[:, :, None, :])
                 x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
-                new_pages.append((kp, vp))
             x = model.ln_f(params["ln_f"], x)
             logits = jnp.einsum("bd,vd->bv", x[:, 0],
                                 params["wte"]["weight"])
             return new_pages, jnp.argmax(logits, -1).astype(jnp.int32)
 
-        out = jnp.zeros((s_tot, self.decode_block), jnp.int32)
+        out = jnp.zeros((s_tot, n_steps), jnp.int32)
 
         def body(j, carry):
             pages, lengths, tokens, out = carry
-            pages, nxt = one_token(pages, lengths, tokens)
+            pages, nxt = one_token(j, pages, lengths, tokens)
             return pages, lengths + 1, nxt, out.at[:, j].set(nxt)
 
         pages, _, _, out = jax.lax.fori_loop(
-            0, self.decode_block, body, (pages, lengths, tokens, out))
+            0, n_steps, body, (pages, lengths, tokens, out))
         return out, pages
 
-    def _prefill_step_impl(self, params, pages, block_tables, starts,
-                           tokens, n_valid):
-        """Fixed-shape BATCHED chunked prefill: ``tokens`` (S, C) holds
-        every in-prefill slot's next prompt chunk (first ``n_valid[s]``
-        real, rest pad; idle lanes ``n_valid == 0``) at absolute
-        positions ``starts[s]..starts[s]+C-1``. Writes each chunk's K/V
-        into its slot's pages (pad/idle lanes hit the null page) and
-        attends causally over everything cached so far — one call
-        advances EVERY admitted request's prefill, where the old loop
-        dispatched per request per chunk. Returns (greedy next token
-        after each slot's last valid position (S,), pages)."""
-        model, cfg = self.model, self.model.cfg
+    def _decode_step_impl(self, params, pages, block_tables, lengths,
+                          tokens, active):
+        """Fixed-shape batched decode of ONE BLOCK of ``decode_block``
+        tokens per slot — one host round-trip per block instead of per
+        token. Non-decoding lanes (``active == 0``: free slots AND
+        slots still mid-prefill, which own live pages the block must
+        not corrupt) write to the null page; post-EOS/post-cap lanes
+        write past their reservation into the null page and produce
+        discarded garbage (the host keeps only in-budget, pre-EOS
+        tokens). Returns (tokens (S, decode_block), pages)."""
+        return self._decode_loop(params, pages, block_tables, lengths,
+                                 tokens, active, model=self.model,
+                                 quantized=self.quantized,
+                                 n_steps=self.decode_block)
+
+    def _draft_propose_step_impl(self, params, pages, block_tables,
+                                 lengths, tokens, active, n_valid):
+        """Fixed-shape draft proposal: ``spec_k`` greedy draft tokens
+        per slot on the DRAFT cache (the first ``spec_k - 1`` become
+        the verify chunk's candidates). Iterations at/after
+        ``n_valid[s]`` write to the null page — their outputs are
+        discarded lanes. Returns (proposals (S, spec_k), pages)."""
+        return self._decode_loop(params, pages, block_tables, lengths,
+                                 tokens, active, n_valid,
+                                 model=self.draft_model,
+                                 quantized=self._draft_quantized,
+                                 n_steps=self.spec_k)
+
+    def _prefill_loop(self, params, pages, block_tables, starts, tokens,
+                      n_valid, *, model=None, quantized=False,
+                      all_positions=False):
+        """The shared chunk-forward behind the batched prefill step, the
+        draft prefill step, and the speculative VERIFY step: ``tokens``
+        (S, C) enter at absolute positions ``starts[s]..starts[s]+C-1``
+        (first ``n_valid[s]`` real, rest pad to the null page), K/V land
+        in each slot's pages (quantized: int8 + scale rows), and every
+        live lane attends causally over everything cached.
+        ``all_positions=False`` returns the greedy next token after each
+        slot's LAST valid position (prefill's first generated token);
+        ``all_positions=True`` returns the greedy argmax after EVERY
+        chunk position (S, C) — the speculative verifier's per-candidate
+        target tokens. Keyword-only args are static config (the AST
+        host-sync lint runs on this body — see :meth:`_decode_loop`).
+        Returns (tokens, pages)."""
+        cfg = model.cfg
         ps = self.cache.config.page_size
         s_tot, c = tokens.shape
         w = block_tables.shape[1]
@@ -1159,48 +1530,123 @@ class ServingEngine:
             bp = params["blocks"][str(i)]
             h = block.ln1(bp["ln1"], x)
             q, k, v = block.attn.qkv_heads(bp["attn"], h)       # (S,H,C,Dh)
-            kp, vp = pages[i]
             k_tok = k.transpose(0, 2, 1, 3)                     # (S,C,H,Dh)
             v_tok = v.transpose(0, 2, 1, 3)
-            kp = kp.at[page_idx, off].set(k_tok.astype(kp.dtype))
-            vp = vp.at[page_idx, off].set(v_tok.astype(vp.dtype))
-            att = DA.ragged_paged_prefill_attention(
-                q.transpose(0, 2, 1, 3), kp, vp, block_tables, starts,
-                n_valid, impl=self.attn_impl)                   # (S,C,H,Dh)
+            if quantized:
+                kp, vp, ksc, vsc = pages[i]
+                kq, k_s = quantize_kv(k_tok, (2, 3))            # (S,C)
+                vq, v_s = quantize_kv(v_tok, (2, 3))
+                kp = kp.at[page_idx, off].set(kq)
+                vp = vp.at[page_idx, off].set(vq)
+                ksc = ksc.at[page_idx, off].set(k_s)
+                vsc = vsc.at[page_idx, off].set(v_s)
+                att = DA.ragged_paged_prefill_int8_attention(
+                    q.transpose(0, 2, 1, 3), kp, vp, ksc, vsc,
+                    block_tables, starts, n_valid,
+                    impl=self.attn_impl)                        # (S,C,H,Dh)
+                new_pages.append((kp, vp, ksc, vsc))
+            else:
+                kp, vp = pages[i]
+                kp = kp.at[page_idx, off].set(k_tok.astype(kp.dtype))
+                vp = vp.at[page_idx, off].set(v_tok.astype(vp.dtype))
+                att = DA.ragged_paged_prefill_attention(
+                    q.transpose(0, 2, 1, 3), kp, vp, block_tables,
+                    starts, n_valid, impl=self.attn_impl)       # (S,C,H,Dh)
+                new_pages.append((kp, vp))
             x = x + block.attn.proj_out(bp["attn"],
                                         att.transpose(0, 2, 1, 3))
             x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
-            new_pages.append((kp, vp))
         x = model.ln_f(params["ln_f"], x)
+        if all_positions:
+            logits = jnp.einsum("scd,vd->scv", x,
+                                params["wte"]["weight"])        # (S,C,V)
+            return jnp.argmax(logits, -1).astype(jnp.int32), new_pages
         last = jnp.take_along_axis(
             x, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1)[:, 0]
         logits = last @ params["wte"]["weight"].T               # (S, V)
         return jnp.argmax(logits, -1).astype(jnp.int32), new_pages
 
+    def _prefill_step_impl(self, params, pages, block_tables, starts,
+                           tokens, n_valid):
+        """Fixed-shape BATCHED chunked prefill: one call advances EVERY
+        admitted request's next prompt chunk (see
+        :meth:`_prefill_loop`). Returns (greedy next token after each
+        slot's last valid position (S,), pages)."""
+        return self._prefill_loop(params, pages, block_tables, starts,
+                                  tokens, n_valid, model=self.model,
+                                  quantized=self.quantized)
+
+    def _draft_prefill_step_impl(self, params, pages, block_tables,
+                                 starts, tokens, n_valid):
+        """The draft model's prefill twin: same chunks, its own cache —
+        keeps the draft's committed prefix in lockstep with the
+        target's so proposals condition on identical context."""
+        return self._prefill_loop(params, pages, block_tables, starts,
+                                  tokens, n_valid,
+                                  model=self.draft_model,
+                                  quantized=self._draft_quantized)
+
+    def _verify_step_impl(self, params, pages, block_tables, starts,
+                          tokens, props, n_valid):
+        """The speculative VERIFY step: the batched-prefill shape is
+        exactly right for k-token verification — assemble the chunk
+        ``[pending, d_1 .. d_{k-1}]`` from each slot's pending token
+        (S,) and the draft's proposals (S, spec_k) IN-GRAPH (so the
+        step dispatches on the un-materialized draft output, no host
+        round-trip between draft and verify), enter it at the slot's
+        live positions, commit its K/V, and return the target's greedy
+        argmax after EVERY position (S, spec_k) so the host can accept
+        the longest agreeing prefix. ONE fixed-shape call verifies all
+        k candidates of every slot."""
+        chunk = jnp.concatenate(
+            [tokens[:, None], props[:, :self.spec_k - 1]], axis=1)
+        return self._prefill_loop(params, pages, block_tables, starts,
+                                  chunk, n_valid, model=self.model,
+                                  quantized=self.quantized,
+                                  all_positions=True)
+
     def _copy_page_impl(self, pages, src, dst):
         """Device-side page copy (CoW of a borrowed shared tail page):
-        every layer's K and V page ``src`` duplicated into ``dst``.
-        Fixed shape — src/dst are traced scalars, so one compile covers
-        every copy."""
+        every layer's K and V page ``src`` duplicated into ``dst`` —
+        including the scale rows of a quantized pool, which travel with
+        their page. Fixed shape — src/dst are traced scalars, so one
+        compile covers every copy."""
         out = []
-        for kp, vp in pages:
-            out.append((kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])))
+        for ent in pages:
+            out.append(tuple(a.at[dst].set(a[src]) for a in ent))
         return out
 
     def _read_page_impl(self, pages, src):
         """One page's K/V across every layer, stacked (2, L, page_size,
-        H, Dh) — the migration shard unit. ``src`` is a traced scalar:
-        one compile covers every page ever snapshotted."""
-        ks = jnp.stack([kp[src] for kp, _vp in pages])
-        vs = jnp.stack([vp[src] for _kp, vp in pages])
-        return jnp.stack([ks, vs])
+        H, Dh) — the migration shard unit; a quantized pool also
+        returns the page's scale rows (2, L, page_size), carried in the
+        same shard. ``src`` is a traced scalar: one compile covers
+        every page ever snapshotted."""
+        ks = jnp.stack([ent[0][src] for ent in pages])
+        vs = jnp.stack([ent[1][src] for ent in pages])
+        kv = jnp.stack([ks, vs])
+        if self.quantized:
+            ksc = jnp.stack([ent[2][src] for ent in pages])
+            vsc = jnp.stack([ent[3][src] for ent in pages])
+            return kv, jnp.stack([ksc, vsc])
+        return kv
 
-    def _write_page_impl(self, pages, dst, kv):
+    def _write_page_impl(self, pages, dst, kv, sc=None):
         """Install one migration shard (the :meth:`_read_page_impl`
-        layout) into page ``dst`` of every layer; pages donated, dst a
-        traced scalar — one compile covers every restore."""
+        layout) into page ``dst`` of every layer — quantized shards
+        carry ``sc`` and restore the scale rows alongside the int8
+        page; pages donated, dst a traced scalar — one compile covers
+        every restore."""
         out = []
-        for i, (kp, vp) in enumerate(pages):
-            out.append((kp.at[dst].set(kv[0, i].astype(kp.dtype)),
-                        vp.at[dst].set(kv[1, i].astype(vp.dtype))))
+        for i, ent in enumerate(pages):
+            if self.quantized:
+                kp, vp, ksc, vsc = ent
+                out.append((kp.at[dst].set(kv[0, i].astype(kp.dtype)),
+                            vp.at[dst].set(kv[1, i].astype(vp.dtype)),
+                            ksc.at[dst].set(sc[0, i]),
+                            vsc.at[dst].set(sc[1, i])))
+            else:
+                kp, vp = ent
+                out.append((kp.at[dst].set(kv[0, i].astype(kp.dtype)),
+                            vp.at[dst].set(kv[1, i].astype(vp.dtype))))
         return out

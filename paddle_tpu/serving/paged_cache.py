@@ -41,6 +41,21 @@ by every follower) and skips prefilling them. Rules that keep it exact:
 - A page whose refcount drops to zero while still published parks in an
   LRU **cached** pool: reusable by future matches, evicted (and
   unpublished) only when the allocator runs dry.
+
+Int8 pages (ISSUE 13): ``dtype=jnp.int8`` stores K/V pages quantized —
+HBM per live token roughly halves, so the same pool hosts ~2x the
+slots. Each layer entry becomes ``(k_pages, v_pages, k_scales,
+v_scales)`` with the scales fp32 ``(num_pages, page_size)`` — one
+symmetric abs-max scale per *token row* of each page
+(:func:`quantize_kv`), stored page-major so scales always travel WITH
+their pages: publication, copy-on-write, the LRU cached pool, and the
+fleet migration shards all move page and scale rows together under one
+page id (a finer grain than one scalar per page, same page-granular
+management — incremental token writes then never requantize already-
+stored rows, so stored content is append-stable and prefix sharing
+stays exact). Dequantization happens INSIDE the dequant-attend kernels
+(:mod:`~paddle_tpu.serving.decode_attention`), fused into the QK and
+PV products — no fp page is ever materialized.
 """
 
 from __future__ import annotations
@@ -76,12 +91,43 @@ class PagedCacheConfig:
     def max_tokens_per_slot(self) -> int:
         return self.max_pages_per_slot * self.page_size
 
+    @property
+    def quantized(self) -> bool:
+        """Int8 page storage with per-token-row fp32 scales."""
+        return jnp.dtype(self.dtype) == jnp.dtype(jnp.int8)
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
 
 class PageOverflowError(RuntimeError):
     """No free pages (or slot capacity exceeded) for a reservation."""
+
+
+#: abs-max floor so an all-zero token row gets a harmless tiny scale
+#: instead of a division by zero (dequant of its zero int8 row is 0)
+KV_SCALE_FLOOR = 1e-8
+
+
+def quantize_kv(x, reduce_axes: Tuple[int, ...]):
+    """Symmetric per-token int8 quantization of a K/V slab.
+
+    ``x`` carries one K (or V) vector per token over its TRAILING
+    ``reduce_axes`` (decode writes ``(S, H, Dh)`` with axes ``(1, 2)``;
+    prefill writes ``(S, C, H, Dh)`` with axes ``(2, 3)``). Returns
+    ``(q int8, scale f32)`` with ``scale = max(|x|) / 127`` per token —
+    the row the page pool stores next to the page so dequantization is
+    ``q * scale`` inside the attend kernel. Per-token granularity keeps
+    incremental page writes append-stable: a new token never forces a
+    requantization of rows already stored (a single per-page scalar
+    would), which is what lets shared/published int8 pages stay
+    bit-stable under prefix sharing and CoW."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=reduce_axes)
+    scale = jnp.maximum(amax, KV_SCALE_FLOOR) / 127.0
+    exp = scale.reshape(scale.shape + (1,) * len(reduce_axes))
+    q = jnp.clip(jnp.round(xf / exp), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 _ROOT_KEY = hash("paddle_tpu.serving.prefix_root")
@@ -133,9 +179,20 @@ class PagedKVCache:
         self.config = config
         c = config
         shape = (c.num_pages, c.page_size, c.num_heads, c.head_dim)
-        self.pages: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
-            (jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
-            for _ in range(c.num_layers)]
+        if c.quantized:
+            # int8 pages + fp32 per-token-row scales, one (k, v, ks, vs)
+            # tuple per layer so scales thread/donate with their pages
+            # through every jitted step as ONE pytree
+            sshape = (c.num_pages, c.page_size)
+            self.pages = [
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(c.num_layers)]
+        else:
+            self.pages: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+                (jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
+                for _ in range(c.num_layers)]
         self.block_tables = np.zeros((c.num_slots, c.max_pages_per_slot),
                                      np.int32)
         self.lengths = np.zeros((c.num_slots,), np.int32)

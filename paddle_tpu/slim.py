@@ -198,6 +198,26 @@ def _is_qleaf(node) -> bool:
     return isinstance(node, dict) and set(node) == {"q", "scale", "axis"}
 
 
+def int8_resident(q):
+    """Keep an int8 array int8 through XLA's constant folding.
+
+    THE keep-quantized idiom, in one place: when int8 data is baked as a
+    CONSTANT into a jitted graph (frozen weights in a native serving
+    artifact, a captured KV page pool), XLA constant-folds the in-graph
+    ``q * scale`` dequant into a full-width float constant at compile
+    time — silently quadrupling the executable's memory and voiding the
+    int8-residency claim. Wrapping the int8 leaf in
+    ``lax.optimization_barrier`` before the dequant pins it: the barrier
+    survives jit, so the s8 constant stays s8 in the optimized HLO and
+    dequantization happens at run time, on-chip. Arguments (the
+    Predictor path, the serving engine's donated pages) stay int8 either
+    way — arguments cannot be folded — so the wrap is harmless there.
+    Users: :func:`dequantize_weights(keep_int8_resident=True)` and the
+    int8 paged KV cache's dequant-attend fallback
+    (:mod:`paddle_tpu.serving.decode_attention`)."""
+    return jax.lax.optimization_barrier(q)
+
+
 def dequantize_weights(qparams, dtype=jnp.float32, *,
                        keep_int8_resident: bool = False):
     """Inverse of :func:`quantize_weights_int8`: rebuild a dense param
@@ -218,7 +238,7 @@ def dequantize_weights(qparams, dtype=jnp.float32, *,
         if _is_qleaf(node):
             q = node["q"]
             if keep_int8_resident:
-                q = jax.lax.optimization_barrier(q)
+                q = int8_resident(q)
             return (q.astype(jnp.float32)
                     * node["scale"]).astype(dtype)
         if isinstance(node, dict):

@@ -144,6 +144,41 @@ class TestPostTrainingQuant:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=0.1, atol=0.02)
 
+    def test_int8_resident_barrier_survives_jit(self):
+        """The reusable keep-quantized helper (ISSUE 13 satellite): an
+        int8 CONSTANT dequantized in-graph is constant-folded to a
+        full-width float by XLA — unless it passes through
+        ``int8_resident`` first, in which case the s8 constant survives
+        into the optimized executable (verified on the compiled HLO,
+        the same check the slim docstring describes)."""
+        q = jnp.asarray(np.random.default_rng(0).integers(
+            -127, 128, (64, 64)), jnp.int8)
+
+        def frozen(keep):
+            qq = slim.int8_resident(q) if keep else q
+            return (qq.astype(jnp.float32) * 0.05).sum()
+
+        kept = jax.jit(lambda: frozen(True)).lower().compile().as_text()
+        folded = jax.jit(lambda: frozen(False)).lower().compile() \
+            .as_text()
+        assert "s8" in kept, "barrier did not keep the int8 resident"
+        assert "s8" not in folded, \
+            "without the barrier the constant should fold to float"
+        # identity at runtime: values unchanged
+        assert float(jax.jit(lambda: frozen(True))()) == pytest.approx(
+            float(frozen(False)))
+
+    def test_dequantize_keep_resident_matches_plain(self):
+        """keep_int8_resident must be numerically a no-op."""
+        model = _MLP()
+        params = model.init(jax.random.PRNGKey(1))
+        qp = slim.quantize_weights_int8(params)
+        a = slim.dequantize_weights(qp)
+        b = slim.dequantize_weights(qp, keep_int8_resident=True)
+        for k in ("fc1", "fc2"):
+            np.testing.assert_array_equal(np.asarray(a[k]["weight"]),
+                                          np.asarray(b[k]["weight"]))
+
 
 class TestDistillation:
     def test_soft_label_loss_zero_when_equal(self):

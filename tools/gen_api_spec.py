@@ -41,8 +41,10 @@ def _is_local(obj, name, mod_name):
 
 def iter_api():
     import paddle_tpu as pt
+    from paddle_tpu import slim as _slim
 
     modules = {
+        "paddle_tpu.slim": _slim,
         "paddle_tpu": pt,
         "paddle_tpu.analysis": pt.analysis,
         "paddle_tpu.nn": pt.nn,

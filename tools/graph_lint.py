@@ -196,7 +196,7 @@ def lint_serving_decode(suppressions, cost=False):
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
-        name="serving_decode", ast_fn=eng._decode_step_impl,
+        name="serving_decode", ast_fn=eng._decode_loop,
         suppressions=suppressions, cost=cost)
 
 
@@ -218,7 +218,60 @@ def lint_serving_prefill(suppressions, cost=False):
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
         jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
-        name="serving_prefill", ast_fn=eng._prefill_step_impl,
+        name="serving_prefill", ast_fn=eng._prefill_loop,
+        suppressions=suppressions, cost=cost)
+
+
+def _tiny_int8_serving_engine(**kw):
+    """The int8 lint/cost engine: same tiny GPT, quantized page pool
+    sized KV-heavy (a big pool on a small model) so the int8-vs-bf16
+    static-bytes gap is far outside the cost-diff tolerance — the
+    committed budget then demonstrably FAILS if the dequant-attend path
+    ever regresses to bf16-level bytes."""
+    kw.setdefault("cache_dtype", jnp.int8)
+    kw.setdefault("num_pages", 513)
+    return _tiny_serving_engine(**kw)
+
+
+def lint_serving_decode_int8(suppressions, cost=False):
+    """The dequant-attend decode step (ISSUE 13): int8 pages + scale
+    rows are all donated into the jitted step (the engine replaces
+    every handle each call), so this must lint clean with NO
+    undonated-buffer suppression; under ``--cost`` the single-device
+    zero-collective contract and the int8 bytes budget apply."""
+    import jax.numpy as jnp
+
+    eng = _tiny_int8_serving_engine()
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.decode_step, analysis.abstractify(eng.params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_decode_int8", ast_fn=eng._decode_loop,
+        suppressions=suppressions, cost=cost)
+
+
+def lint_serving_prefill_int8(suppressions, cost=False):
+    """The dequant-attend batched-prefill step — also the shape of the
+    speculative VERIFY step (same jitted body, all-position argmax), so
+    linting it covers both surfaces."""
+    import jax.numpy as jnp
+
+    eng = _tiny_int8_serving_engine()
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.prefill_step, analysis.abstractify(eng.params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_prefill_int8", ast_fn=eng._prefill_loop,
         suppressions=suppressions, cost=cost)
 
 
@@ -306,7 +359,8 @@ def lint_kernel_registry(suppressions, cost=False):
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
                   lint_convgroup, lint_serving_decode,
-                  lint_serving_prefill, lint_embedding_install,
+                  lint_serving_prefill, lint_serving_decode_int8,
+                  lint_serving_prefill_int8, lint_embedding_install,
                   lint_embedding_lookup, lint_kernel_registry],
 }
 

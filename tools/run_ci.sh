@@ -51,13 +51,25 @@ r = json.load(sys.stdin)
 for k in ("ttft_p50_s", "ttft_p90_s", "ttft_p99_s", "ttft_budget_s",
           "queue_wait_p99_s", "admit_to_first_token_p99_s",
           "prefix_variant", "slo_burn_rate", "slo_alerts_total",
-          "trace_json", "trace_spans"):
+          "trace_json", "trace_spans", "tokens_per_hbm_byte",
+          "tokens_per_hbm_byte_bf16", "quant_static_bytes_ratio",
+          "quant_speedup", "quant_variant", "spec_accept_rate",
+          "spec_variant"):
     assert k in r, f"BENCH_SERVING missing {k}"
 assert r["ttft_slo_met"], "dryrun TTFT p99 blew the stated budget"
 pv = r["prefix_variant"]
 assert pv["prefill_tokens_computed"] < pv["prompt_tokens_submitted"], \
     "prefix sharing saved no prefill work"
 assert pv["recompiles"] == 0 and r["decode_recompiles_after_warmup"] == 0
+# ISSUE 13: the int8 paged cache must statically beat the bf16 pool by
+# >= 1.8x tokens-per-HBM-byte (cost-model derived, deterministic), the
+# speculative variant must be bit-exact vs non-speculative greedy, and
+# neither new variant may recompile in steady state
+assert r["quant_static_bytes_ratio"] >= 1.8, r["quant_static_bytes_ratio"]
+assert r["spec_variant"]["exact_vs_nonspeculative"] is True
+assert r["quant_variant"]["recompiles"] == 0
+assert r["spec_variant"]["recompiles"] == 0
+assert 0.0 <= r["spec_accept_rate"] <= 1.0
 # the ISSUE 10 trace artifact: present, Perfetto-valid (every event
 # carries ph/ts/pid/tid), and carrying the lifecycle + decision
 # annotations the bench self-check pinned
@@ -158,7 +170,8 @@ for k in ("kernels", "tuner_cache_hits", "tuner_cache_misses",
     assert k in r, f"BENCH_KERNELS missing {k}"
 ks = r["kernels"]
 assert set(ks) == {"flash_attention", "ragged_paged_decode",
-                   "ragged_paged_prefill"}, sorted(ks)
+                   "ragged_paged_prefill", "ragged_paged_decode_int8",
+                   "ragged_paged_prefill_int8"}, sorted(ks)
 for name, buckets in ks.items():
     assert len(buckets) == 3, f"{name}: expected 3 shape buckets"
     for key, b in buckets.items():
