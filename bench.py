@@ -789,7 +789,16 @@ ROUTER_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                  "prefix_tokens_shared",
                  "recompiles_after_warmup", "num_requests",
                  "replica_slots", "decode_cap",
-                 "trace_json", "trace_spans", "device")
+                 "trace_json", "trace_spans", "device", "chaos")
+
+# the chaos variant's sub-schema (ISSUE 14) — shared with
+# tools/check_metrics_log.py:validate_chaos_section so CI and the bench
+# pin the same contract
+CHAOS_SCHEMA = ("lost_requests", "redrive_parity", "redrives",
+                "redriven_requests", "shed_structured", "ejected",
+                "goodput_tokens_per_sec", "goodput_no_chaos",
+                "goodput_ratio", "breaker_cycle_ok",
+                "breaker_transitions", "recompiles")
 
 
 def router_json_path(dryrun: bool) -> str:
@@ -1015,7 +1024,115 @@ def run_bench_router(dev, dryrun=False):
         m is not None and r is not None and np.array_equal(r, m)
         for r, m in zip(ref_outs, mig_outs))
 
+    # --- chaos leg (ISSUE 14): involuntary failure on the 4-replica
+    # fleet — one replica CRASHES mid-burst (ejected, requests
+    # redriven exactly-once), another's transport flakes (circuit
+    # breaker opens, half-open probes, closes). Gates: 0 requests
+    # silently lost, redriven greedy outputs byte-identical to the
+    # failure-free run, the breaker completes a visible full cycle,
+    # and the whole leg stays at zero recompiles with detection +
+    # breakers armed.
+    chaos_prompts = fresh_prompts()
+    router_cr = fleet.FleetRouter(replicas, registry=reg,
+                                  tracer=tracer, seed=17)
+    ref_chaos, _ = burst(router_cr, chaos_prompts)
+    chaos_clean_busy = max(rep.busy_s for rep in replicas)
+    chaos_clean_tokens = sum(len(o) for o in ref_chaos)
+    goodput_clean = chaos_clean_tokens / max(chaos_clean_busy, 1e-9)
+
+    crash_step = 4 if dryrun else 6
+    c_crash = fleet.ChaosReplica(replicas[1], crash_on_step=crash_step)
+    c_flaky = fleet.ChaosReplica(replicas[2], submit_failures=2)
+    # breaker trips at 2 failures, well under the death threshold: the
+    # flaky replica must CYCLE (open -> half-open -> closed), not eject
+    fpol = fleet.FaultPolicy(max_consecutive_failures=6,
+                             probe_timeout_s=120.0,
+                             breaker_threshold=2,
+                             breaker_cooldown_s=0.2, max_redrives=4)
+    router_x = fleet.FleetRouter(
+        [replicas[0], c_crash, c_flaky, replicas[3]],
+        registry=reg, tracer=tracer, seed=17, faults=fpol)
+    for rep in replicas:
+        rep.busy_s = 0.0
+
+    def tiny_prompt():
+        return rng.integers(1, cfg.vocab_size,
+                            min(len_set)).astype(np.int32)
+
+    # deterministically trip the flaky transport before the burst: keep
+    # feeding tiny requests until its breaker opens (p2c favors the
+    # always-empty flaky replica, so this converges in a few submits;
+    # the failed submits retry on peers — the caller never loses one)
+    pre_frids = []
+    for _ in range(64):
+        pre_frids.append(router_x.submit(tiny_prompt(), 4))
+        if (c_flaky.name, "closed", "open") in router_x.breaker_transitions:
+            break
+    else:
+        raise RuntimeError("chaos leg: flaky breaker never opened")
+    frids_x = [router_x.submit(p, cap) for p in chaos_prompts]
+    steps = 0
+    while not router_x.idle():
+        router_x.step()
+        steps += 1
+        if steps > 1_000_000:
+            raise RuntimeError("chaos burst did not converge")
+    # recovery wave: let the breaker cooldown elapse (a dryrun burst
+    # can finish inside it), then the router routes the next submit as
+    # the deliberate half-open probe; the healed transport answers and
+    # the breaker closes
+    time.sleep(fpol.breaker_cooldown_s + 0.05)
+    probe_frids = [router_x.submit(tiny_prompt(), 4) for _ in range(2)]
+    while not router_x.idle():
+        router_x.step()
+    chaos_busy = max(rep.busy_s for rep in replicas)
+    chaos_outs, chaos_shed, chaos_lost = [], 0, 0
+    for f in frids_x:
+        o = router_x.result(f)
+        chaos_outs.append(o)
+        if o is None:
+            if router_x.reject_reason(f) is not None:
+                chaos_shed += 1
+            else:
+                chaos_lost += 1
+    for f in pre_frids + probe_frids:       # no-silent-loss covers ALL
+        if router_x.result(f) is None \
+                and router_x.reject_reason(f) is None:
+            chaos_lost += 1
+    chaos_parity = all(
+        o is not None and np.array_equal(r, o)
+        for r, o in zip(ref_chaos, chaos_outs))
+    chaos_tokens = sum(len(o) for o in chaos_outs if o is not None)
+    goodput_chaos = chaos_tokens / max(chaos_busy, 1e-9)
+    flaky_trans = [(old, new) for (nm, old, new)
+                   in router_x.breaker_transitions
+                   if nm == c_flaky.name]
+    cycle = [("closed", "open"), ("open", "half_open"),
+             ("half_open", "closed")]
+    it = iter(flaky_trans)
+    breaker_cycle_ok = all(t in it for t in cycle)   # ordered subseq
+    chaos = {
+        "lost_requests": int(chaos_lost),
+        "redrive_parity": bool(chaos_parity),
+        "redrives": int(router_x.redrives_total),
+        # distinct requests redriven (an unlucky request can redrive
+        # more than once): unique trace ids on the redrive spans
+        "redriven_requests": len({s.trace_id for s in tracer.spans()
+                                  if s.name == "router.redrive"}),
+        "shed_structured": int(chaos_shed),
+        "ejected": int(router_x.ejected_total),
+        "goodput_tokens_per_sec": round(goodput_chaos, 2),
+        "goodput_no_chaos": round(goodput_clean, 2),
+        "goodput_ratio": round(goodput_chaos
+                               / max(goodput_clean, 1e-9), 4),
+        "breaker_cycle_ok": bool(breaker_cycle_ok),
+        "breaker_transitions": [f"{nm}:{old}->{new}" for (nm, old, new)
+                                in router_x.breaker_transitions],
+        "recompiles": 0,        # re-pinned below after det.check()
+    }
+
     det.check()
+    chaos["recompiles"] = det.recompiles
 
     # --- trace artifact: the cross-replica timeline (ISSUE acceptance:
     # one trace shows a request crossing the fleet through a migration)
@@ -1059,6 +1176,7 @@ def run_bench_router(dev, dryrun=False):
         "balance_routed": int(router_a.routed_balance_total),
         "prefix_tokens_shared": int(prefix_tokens_shared),
         "recompiles_after_warmup": det.recompiles,
+        "chaos": chaos,
         "num_requests": n_req,
         "replica_slots": slots,
         "decode_cap": cap,
@@ -1075,6 +1193,24 @@ def run_bench_router(dev, dryrun=False):
     if missing:
         raise RuntimeError(f"BENCH_ROUTER schema self-check failed: "
                            f"missing {missing}")
+    missing_chaos = [k for k in CHAOS_SCHEMA if k not in chaos]
+    if missing_chaos:
+        raise RuntimeError(f"BENCH_ROUTER chaos section self-check "
+                           f"failed: missing {missing_chaos}")
+    if chaos["lost_requests"] != 0:
+        raise RuntimeError(
+            f"chaos leg lost {chaos['lost_requests']} requests "
+            "silently — the no-silent-loss contract broke")
+    if not chaos["redrive_parity"]:
+        raise RuntimeError("chaos redrive parity broken: redriven "
+                           "outputs differ from the failure-free run")
+    if chaos["ejected"] < 1 or chaos["redrives"] < 1:
+        raise RuntimeError("chaos leg ejected/redrove nothing — the "
+                           "crash injection is dead")
+    if not chaos["breaker_cycle_ok"]:
+        raise RuntimeError(
+            f"breaker never completed open->half_open->closed "
+            f"(saw {chaos['breaker_transitions']})")
     if not parity_ok:
         raise RuntimeError("migration parity broken: drained run's "
                            "greedy outputs differ from the clean run")

@@ -231,7 +231,7 @@ def make_serving_engine(model, params, **kwargs):
 def make_serving_fleet(model, params, *, num_replicas: int = 2,
                        policy: str = "affinity", registry=None,
                        tracer=None, warmup: bool = True,
-                       autoscaler=None, seed: int = 0,
+                       autoscaler=None, seed: int = 0, faults=None,
                        **engine_kwargs):
     """Multi-replica serving front end — N continuous-batching
     :func:`make_serving_engine` replicas behind one
@@ -245,8 +245,13 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
     fleet emits ONE timeline; each gets its own metrics registry plus
     the shared ``registry`` for fleet-level series. ``engine_kwargs``
     pass through to every :class:`~paddle_tpu.serving.ServingEngine`.
-    Returns the router; replicas are warmed (every bucket precompiled)
-    before it is handed back unless ``warmup=False``."""
+    Fault tolerance is armed by default (``faults=`` takes a
+    :class:`~paddle_tpu.serving.fleet.FaultPolicy`): crashed/hung
+    replicas are detected and ejected with their requests redriven
+    exactly-once, and per-replica circuit breakers pause routing to
+    transiently sick replicas. Returns the router; replicas are warmed
+    (every bucket precompiled) before it is handed back unless
+    ``warmup=False``."""
     from paddle_tpu import observability as _obs
     from paddle_tpu import serving as _serving
     from paddle_tpu.serving import fleet as _fleet
@@ -263,7 +268,7 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
         reps.append(rep)
     return _fleet.FleetRouter(reps, policy=policy, registry=registry,
                               tracer=tracer, seed=seed,
-                              autoscaler=autoscaler)
+                              autoscaler=autoscaler, faults=faults)
 
 
 def make_embedding_serving_engine(store, model=None, params=None,

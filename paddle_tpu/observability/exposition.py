@@ -11,9 +11,13 @@ dependencies — the container rule), serving three read-only views:
                 object per registered provider (the serving engine
                 publishes slot occupancy, queue depth, page
                 utilization, recompile count — see
-                ``ServingEngine.health``). A provider that raises marks
-                the response degraded (HTTP 503) instead of crashing
-                the endpoint.
+                ``ServingEngine.health``; the fleet router adds a
+                breaker section: per-replica circuit-breaker states,
+                routable capacity, eject/redrive totals). A provider
+                that raises — or reports ``{"degraded": true}``, as
+                the fleet does while any breaker is open — marks the
+                response degraded (HTTP 503) instead of crashing the
+                endpoint.
   ``/traces``   recent ring-buffer spans as JSON (``?limit=N``,
                 ``?trace_id=T``), newest last.
 
@@ -168,12 +172,19 @@ class ExpositionServer:
 
     # -- payload builders (also callable without HTTP, for tests) ---------
     def healthz(self):
-        """(status, payload): "ok" unless any provider raised."""
+        """(status, payload): "ok" unless any provider raised OR
+        reported itself degraded (``{"degraded": true}`` in its
+        payload — e.g. the fleet router's breaker section while any
+        circuit breaker is not closed), so load balancers see a sick
+        fleet as HTTP 503 without the provider having to crash."""
         status = "ok"
         providers: Dict[str, dict] = {}
         for name, fn in self._health.items():
             try:
                 providers[name] = fn()
+                if isinstance(providers[name], dict) \
+                        and providers[name].get("degraded"):
+                    status = "degraded"
             except Exception as e:
                 status = "degraded"
                 providers[name] = {"error": f"{type(e).__name__}: {e}"}

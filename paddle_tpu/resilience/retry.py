@@ -15,7 +15,10 @@ Design points:
   callers see the real failure, not a retry-framework wrapper.
 - every retry bumps the ``resilience_retries_total`` counter (labelled by
   ``op``) so a run that is quietly limping on a sick filesystem is visible
-  in the observability exposition long before it dies.
+  in the observability exposition long before it dies; every GIVE-UP —
+  attempt budget spent or deadline crossed — bumps
+  ``resilience_retry_exhausted_total{op}``, so a limping-then-dead
+  dependency is distinguishable from a merely limping one.
 - fully injectable (``sleep``, ``clock``, ``rng``) — the fault-injection
   suite drives it deterministically with zero real sleeping.
 """
@@ -76,10 +79,21 @@ def retry_call(fn: Callable, *args,
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:
+            # exhaustion is its own signal: retries_total alone cannot
+            # distinguish a limping dependency from a limping-then-DEAD
+            # one — the give-up counter is what alerts page on
             if attempt >= policy.max_attempts:
+                observability.counter(
+                    "resilience_retry_exhausted_total",
+                    "retry give-ups (attempt budget or deadline spent)"
+                ).inc(op=op)
                 raise
             delay = policy.delay(attempt, rng)
             if clock() + delay - start > policy.deadline_s:
+                observability.counter(
+                    "resilience_retry_exhausted_total",
+                    "retry give-ups (attempt budget or deadline spent)"
+                ).inc(op=op)
                 raise  # the original error, not a deadline wrapper
             observability.counter(
                 "resilience_retries_total",

@@ -160,7 +160,8 @@ class ServingEngine:
                  ttft_budget_s: Optional[float] = None,
                  slo_windows=(60.0, 300.0),
                  draft_model=None, draft_params=None, spec_k: int = 4,
-                 draft_cache_dtype=None):
+                 draft_cache_dtype=None,
+                 snapshot_every_blocks: Optional[int] = None):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
@@ -303,6 +304,23 @@ class ServingEngine:
         # when warmup has not run yet)
         self.warmed_signatures: set = set()
         self.bucket_costs: Dict[tuple, object] = {}
+        # micro-checkpoints (fleet fault tolerance): every K decode
+        # blocks an in-flight slot's snapshot_slot lands in a host-side
+        # outbox the replica handle drains to the router — a crashed
+        # replica's requests then warm-restore on a peer instead of
+        # re-decoding from the prompt. Host-side page reads only
+        # (("page_read",) is a warmed signature), so the zero-recompile
+        # invariant holds with checkpointing on.
+        if snapshot_every_blocks is not None:
+            if self.speculative:
+                raise ValueError(
+                    "micro-checkpoints need slot migration, which "
+                    "speculative engines do not support")
+            if snapshot_every_blocks < 1:
+                raise ValueError("snapshot_every_blocks must be >= 1")
+        self.snapshot_every_blocks = snapshot_every_blocks
+        self._micro_snaps: Dict[int, Dict] = {}
+        self._last_snap_blocks: Dict[int, int] = {}
         # externally-minted trace ids (router propagation) so
         # request_stats carries them even with tracing disabled
         self._ext_trace: Dict[int, int] = {}
@@ -522,6 +540,8 @@ class ServingEngine:
             self._reg.counter("serving_steps_total").inc()
             self.recompile_detector.check()
             finished.update(self._evict())
+            if self.snapshot_every_blocks is not None:
+                self._take_micro_snapshots()
 
         if self.slo_monitor is not None:
             self.slo_monitor.check()
@@ -738,6 +758,8 @@ class ServingEngine:
                 else float(self._ext_trace.pop(req.rid, 0)),
             }
             self._ext_trace.pop(req.rid, None)
+            self._micro_snaps.pop(req.rid, None)
+            self._last_snap_blocks.pop(req.rid, None)
             if root is not None:
                 root.add_event("finished", tokens=len(st.generated))
                 root.set_attrs(
@@ -1209,6 +1231,30 @@ class ServingEngine:
             "manifest": manifest,
         }
 
+    def _take_micro_snapshots(self):
+        """Refresh the micro-checkpoint outbox: any in-flight decode
+        slot that crossed another ``snapshot_every_blocks`` decode
+        blocks gets a fresh :meth:`snapshot_slot` keyed by rid (newest
+        wins — the outbox holds at most one snapshot per request)."""
+        k = self.snapshot_every_blocks
+        for i in self.scheduler.decode_slots():
+            st = self.scheduler.slots[i]
+            rid = st.request.rid
+            acc = self._phase_acc.get(rid)
+            blocks = int(acc["decode_blocks"]) if acc else 0
+            if blocks and blocks % k == 0 \
+                    and self._last_snap_blocks.get(rid) != blocks:
+                self._micro_snaps[rid] = self.snapshot_slot(i)
+                self._last_snap_blocks[rid] = blocks
+
+    def poll_micro_snapshots(self) -> Dict[int, Dict]:
+        """Drain the micro-checkpoint outbox (``{rid: snapshot}``,
+        newest per request). The fleet replica handle forwards these to
+        the router, which keeps the latest as the warm-restore seed
+        bounding re-decode work after a crash."""
+        out, self._micro_snaps = self._micro_snaps, {}
+        return out
+
     def _shard_digest(self, shard) -> str:
         """sha256 of one migration shard — a quantized shard hashes the
         int8 KV AND its scale rows as one digest (a scale-only
@@ -1264,6 +1310,8 @@ class ServingEngine:
         rid = st.request.rid
         self._phase_acc.pop(rid, None)
         self._ext_trace.pop(rid, None)
+        self._micro_snaps.pop(rid, None)
+        self._last_snap_blocks.pop(rid, None)
         root = self._req_spans.pop(rid, None)
         if root is not None:
             root.add_event("migrated_out", slot=slot,

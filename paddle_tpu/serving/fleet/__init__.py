@@ -24,15 +24,35 @@ ServingEngine` replicas as cattle (ROADMAP open item 2). Three parts:
    requests re-routed, in-flight slots snapshotted (sha256-verified
    per-page shards), restored into peers, decode resumed
    byte-identically.
+4. **Fault tolerance** (`faults.py`, ISSUE 14): involuntary failure
+   as a first-class citizen — :class:`FailureDetector` declares a
+   replica dead (crash, hang, N consecutive exceptions, replica-
+   surfaced loop death), :meth:`FleetRouter.eject_replica` redrives
+   its requests **exactly once** (bit-identical greedy outputs, warm
+   micro-checkpoint restore or cold ``prompt + observed`` resubmit,
+   structured sheds for hopeless requests), per-replica
+   :class:`CircuitBreaker`\\ s pause routing to transiently sick
+   replicas (the autoscaler spawns replacements for the lost
+   capacity), and :class:`ChaosReplica` injects all of it
+   deterministically for the chaos test battery.
 """
 
 from paddle_tpu.serving.fleet.replica import LocalReplica, ReplicaHandle
 from paddle_tpu.serving.fleet.router import FleetMonitor, FleetRouter
 from paddle_tpu.serving.fleet.autoscaler import FleetAutoscaler
+from paddle_tpu.serving.fleet.faults import (ChaosReplica, ChaosSpec,
+                                             CircuitBreaker,
+                                             FailureDetector, FaultPolicy,
+                                             ReplicaCrashed,
+                                             ReplicaUnavailable,
+                                             chaos_schedule)
 from paddle_tpu.serving.engine import SlotMigrationError
 from paddle_tpu.serving.paged_cache import prompt_prefix_digests
 
 __all__ = [
     "ReplicaHandle", "LocalReplica", "FleetRouter", "FleetMonitor",
     "FleetAutoscaler", "SlotMigrationError", "prompt_prefix_digests",
+    "ChaosReplica", "ChaosSpec", "CircuitBreaker", "FailureDetector",
+    "FaultPolicy", "ReplicaCrashed", "ReplicaUnavailable",
+    "chaos_schedule",
 ]

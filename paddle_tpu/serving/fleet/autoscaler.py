@@ -39,8 +39,13 @@ class FleetAutoscaler:
     warm) a new replica; the autoscaler warms it before attaching.
     ``tick()`` is called once per fleet step (the router does this
     automatically when constructed with ``autoscaler=``); it returns
-    ``"scale_out"`` / ``"scale_in"`` / ``None`` for observability and
-    tests.
+    ``"scale_out"`` / ``"scale_in"`` / ``"replace"`` / ``None`` for
+    observability and tests. ``"replace"`` (ISSUE 14) restores
+    capacity lost *involuntarily*: when ejections and open circuit
+    breakers drop the ROUTABLE replica count below ``min_replicas``,
+    a warmed replacement spawns (cooldown-gated) — the autoscaler
+    treats an open breaker exactly as lost capacity, while voluntary
+    drains shrink the fleet on purpose and are never replaced.
     """
 
     def __init__(self, spawn_replica: Callable[[int], object], *,
@@ -79,12 +84,27 @@ class FleetAutoscaler:
 
     # -- signal reads ------------------------------------------------------
 
+    def _routable(self):
+        """Replicas new work can land on — open breakers and ejected
+        replicas are LOST capacity, invisible to the burn/idle signals
+        and replaced by :meth:`_replace`."""
+        router = self.router
+        if hasattr(router, "is_routable"):
+            return [r for r in router.replicas if router.is_routable(r)]
+        return list(router.replicas)
+
     def _pressure(self) -> float:
-        """Hottest replica's burn, counted only when BOTH windows
-        breach (the alerting shape — one latency spike never scales)."""
+        """Hottest routable replica's burn, counted only when BOTH
+        windows breach (the alerting shape — one latency spike never
+        scales)."""
         worst = 0.0
-        for rep in self.router.replicas:
-            slo = rep.health().get("slo") or {}
+        for rep in self._routable():
+            try:
+                slo = rep.health().get("slo") or {}
+            except NotImplementedError:
+                raise
+            except Exception:
+                continue            # dying replica: the detector's job
             bf = float(slo.get("burn_fast", 0.0))
             bs = float(slo.get("burn_slow", 0.0))
             if bf >= self.scale_out_burn and bs >= self.scale_out_burn:
@@ -105,6 +125,13 @@ class FleetAutoscaler:
         if now < self._cooldown_until:
             return None
         n = len(self.router.replicas)
+        n_routable = len(self._routable())
+        # lost capacity first: a crash ejection or an open breaker has
+        # dropped the ROUTABLE fleet below the floor — spawn a warmed
+        # replacement (the crashed/drained distinction from PR 9:
+        # drains shrink the fleet on purpose and do not replace)
+        if n_routable < self.min_replicas and n < self.max_replicas:
+            return self._replace(n_routable)
         burn = self._pressure()
         if burn > 0.0 and n < self.max_replicas:
             self._idle_since = None
@@ -122,6 +149,29 @@ class FleetAutoscaler:
             return None
         self._idle_since = None
         return None
+
+    def _replace(self, n_routable: int) -> str:
+        """Spawn a warmed replacement for capacity lost involuntarily
+        (ejected replica / open breaker) — same full-warmup-before-
+        traffic discipline as scale-out, its own counter so crash
+        churn is distinguishable from demand growth."""
+        rep = self.spawn_replica(self._spawned)
+        self._spawned += 1
+        rep.warmup()
+        self.router.add_replica(rep)
+        self._cooldown_until = self._clock() + self.cooldown_s
+        self._reg.counter(
+            "fleet_replace_spawn_total",
+            "replicas spawned to replace lost capacity").inc()
+        self.events.append({"action": "replace",
+                            "routable": n_routable,
+                            "replicas": len(self.router.replicas),
+                            "replica": rep.name})
+        if self.router.tracer.enabled:
+            self.router.tracer.record_span(
+                "fleet.replace", duration_s=0.0, routable=n_routable,
+                replicas=len(self.router.replicas), replica=rep.name)
+        return "replace"
 
     def _scale_out(self, burn: float) -> str:
         rep = self.spawn_replica(self._spawned)
@@ -144,9 +194,18 @@ class FleetAutoscaler:
 
     def _scale_in(self) -> Optional[str]:
         from paddle_tpu.serving.engine import SlotMigrationError
+        # victims come from the ROUTABLE set: draining a breaker-open
+        # replica would try to live-migrate through the very transport
+        # that is failing. A fleet with no routable victim (fleet-wide
+        # breaker flap) simply cannot shrink right now — never crash
+        # the serve loop over it.
+        cands = [r for r in self._routable()
+                 if not getattr(r, "draining", False)]
+        if not cands:
+            self._idle_since = None
+            return None
         victim = min(
-            (r for r in self.router.replicas
-             if not getattr(r, "draining", False)),
+            cands,
             key=lambda r: float(
                 r.health().get("requests_in_flight", 0)))
         try:

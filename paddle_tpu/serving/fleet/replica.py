@@ -73,6 +73,34 @@ class ReplicaHandle:
     def request_stats(self, rid: int) -> Optional[Dict[str, float]]:
         raise NotImplementedError
 
+    def progress(self, since: Optional[Dict[int, int]] = None
+                 ) -> Dict[int, List[int]]:
+        """Tokens emitted so far per in-flight request (``{rid:
+        [tokens]}``). The router polls this every step so a crash
+        never takes the emitted prefix with it — the cold-redrive path
+        resubmits ``prompt + observed`` to a peer. ``since`` maps rid →
+        token count the caller already holds; only the tokens past
+        that index come back (the poll then costs O(new tokens) per
+        step instead of re-copying whole streams). Transports without
+        progress export return ``{}`` (redrive then re-decodes from
+        the prompt; greedy determinism keeps outputs identical)."""
+        return {}
+
+    def poll_checkpoints(self) -> List[Tuple[int, Dict]]:
+        """Drain the replica's micro-checkpoint outbox (``(rid,
+        snapshot)`` pairs — see ``ServingEngine.poll_micro_snapshots``).
+        The router keeps the newest per request as the warm-restore
+        seed that bounds re-decode work after a crash."""
+        return []
+
+    def reject_reason(self, rid: int):
+        """Structured reject for a request the replica's own engine
+        shed after queueing (TTFT deadline expired before admission);
+        None otherwise. The router polls this so an engine-side shed
+        surfaces as a fleet-level structured reject instead of a
+        silently-lost request."""
+        return None
+
     def drain_queue(self) -> List[Tuple]:
         """Pop every queued (not yet admitted) request; returns
         ``(rid, prompt, max_new_tokens, eos_id, lane, ttft_deadline_s)``
@@ -113,6 +141,13 @@ class LocalReplica(ReplicaHandle):
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.draining = False
+        # involuntary-failure surface: the background loop records its
+        # own death here (health()/running() expose it, the router's
+        # detector acts on it), and every step beats the heartbeat the
+        # hang detector ages
+        self.failed = False
+        self.last_error: Optional[str] = None
+        self._last_beat = time.monotonic()
         # serializes engine MUTATIONS (submit vs step vs migration)
         # for threaded mode — a router-thread submit must not mutate
         # the scheduler queue mid-iteration. health() stays lock-free:
@@ -125,6 +160,10 @@ class LocalReplica(ReplicaHandle):
                ttft_deadline_s: Optional[float] = None,
                trace_id: Optional[int] = None) -> int:
         with self._lock:
+            # answering a submit IS a heartbeat: a sync-mode replica
+            # only beats when stepped, and the first probe after a
+            # long warmup must not read the gap as a hang
+            self._last_beat = time.monotonic()
             return self.engine.submit(prompt, max_new_tokens, eos_id,
                                       lane=lane,
                                       ttft_deadline_s=ttft_deadline_s,
@@ -134,12 +173,19 @@ class LocalReplica(ReplicaHandle):
         t0 = time.monotonic()
         with self._lock:
             out = self.engine.step()
-        self.busy_s += time.monotonic() - t0
+        now = time.monotonic()
+        self.busy_s += now - t0
         self.steps += 1
+        self._last_beat = now
         return out
 
     def health(self) -> Dict[str, object]:
-        return self.engine.health()
+        h = dict(self.engine.health())
+        h["heartbeat_age_s"] = time.monotonic() - self._last_beat
+        h["failed"] = self.failed
+        if self.last_error is not None:
+            h["last_error"] = self.last_error
+        return h
 
     def page_size(self) -> int:
         return self.engine.cache.config.page_size
@@ -163,7 +209,30 @@ class LocalReplica(ReplicaHandle):
 
     def warmup(self):
         self.engine.warmup()
+        self._last_beat = time.monotonic()
         return self
+
+    def progress(self, since: Optional[Dict[int, int]] = None
+                 ) -> Dict[int, List[int]]:
+        with self._lock:
+            eng = self.engine
+            out = {}
+            for i in eng.scheduler.active_slots():
+                st = eng.scheduler.slots[i]
+                rid = st.request.rid
+                lo = since.get(rid, 0) if since else 0
+                # tail-only slice: O(new tokens) per poll, not O(all)
+                out[rid] = list(st.generated[lo:]) if lo \
+                    else list(st.generated)
+            return out
+
+    def poll_checkpoints(self) -> List[Tuple[int, Dict]]:
+        with self._lock:
+            return list(self.engine.poll_micro_snapshots().items())
+
+    def reject_reason(self, rid: int):
+        with self._lock:
+            return self.engine.reject_reason(rid)
 
     # -- drain / migration -------------------------------------------------
     def drain_queue(self) -> List[Tuple]:
@@ -194,17 +263,32 @@ class LocalReplica(ReplicaHandle):
         """Background step loop: steps whenever the engine has queued
         or in-flight work, sleeps briefly otherwise. The router keeps
         submitting from its own thread; ``health()`` polls stay safe
-        (engine-published snapshots)."""
+        (engine-published snapshots). A raising ``step()`` must NOT
+        die silently (the replica would stay routable while its queue
+        rots forever): the loop records ``last_error``, marks the
+        replica ``failed`` — visible through ``health()`` and
+        ``running()`` — and exits, so the router's failure detector
+        ejects and redrives."""
         if self._thread is not None:
             raise RuntimeError(f"{self.name} already started")
+        if self.failed:
+            raise RuntimeError(
+                f"{self.name} failed earlier ({self.last_error}); "
+                "build a fresh replica instead of restarting this one")
         self._stop.clear()
 
         def loop():
             while not self._stop.is_set():
-                if self.engine.scheduler.idle():
-                    time.sleep(idle_sleep_s)
-                    continue
-                self.step()
+                try:
+                    if self.engine.scheduler.idle():
+                        self._last_beat = time.monotonic()
+                        time.sleep(idle_sleep_s)
+                        continue
+                    self.step()
+                except Exception as e:     # surface, never rot silently
+                    self.last_error = f"{type(e).__name__}: {e}"
+                    self.failed = True
+                    return
 
         self._thread = threading.Thread(
             target=loop, name=f"fleet-{self.name}", daemon=True)
@@ -219,7 +303,8 @@ class LocalReplica(ReplicaHandle):
         self._thread = None
 
     def running(self) -> bool:
-        return self._thread is not None
+        return (self._thread is not None and self._thread.is_alive()
+                and not self.failed)
 
     def close(self):
         self.stop()

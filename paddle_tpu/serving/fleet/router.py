@@ -26,19 +26,72 @@ Scale-in drains **migrate** instead of killing: queued requests are
 re-routed to peers; in-flight slots are snapshotted (sha256-verified
 per-page shards), restored into peers' free slots, and resume decode
 byte-identically — see :meth:`FleetRouter.drain_replica`.
+
+**Involuntary failure** (ISSUE 14) is the hard counterpart: a replica
+that crashes, hangs, or starts throwing is *ejected* — its KV is gone,
+so queued requests re-route and in-flight requests are **redriven**:
+the router records every request's prompt/budget and polls emitted
+tokens each step, so after a crash it resubmits ``prompt +
+tokens-observed-so-far`` as the new prompt with the remaining
+``max_new_tokens`` budget to a peer (or warm-restores the newest
+micro-checkpoint when the engine runs ``snapshot_every_blocks``), then
+concatenates the observed prefix onto the peer's output exactly once —
+greedy decode is deterministic, so the final token sequence is
+bit-identical to a failure-free run. A per-request redrive budget and
+deadline awareness turn hopeless requests into structured
+:class:`~paddle_tpu.serving.Reject`\\ s (``redrive_budget`` /
+``deadline_expired`` / ``no_replica``) — never silent loss. Transient
+sickness short of death trips a per-replica
+:class:`~paddle_tpu.serving.fleet.CircuitBreaker` (closed → open →
+half-open probe → closed) that pauses routing without ejecting; all of
+it is driven by the :class:`~paddle_tpu.serving.fleet.FailureDetector`
+under one :class:`~paddle_tpu.serving.fleet.FaultPolicy`.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import random
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from paddle_tpu.serving.engine import SlotMigrationError
+from paddle_tpu.serving.fleet.faults import (BREAKER_GAUGE, CircuitBreaker,
+                                             FailureDetector, FaultPolicy,
+                                             ReplicaCrashed,
+                                             ReplicaUnavailable)
 from paddle_tpu.serving.paged_cache import prompt_prefix_digests
-from paddle_tpu.serving.scheduler import LoadShedError
+from paddle_tpu.serving.scheduler import LoadShedError, Reject
+
+# exceptions a peer retry can absorb: transport-shaped failures. A
+# ValueError (malformed request) would fail identically everywhere and
+# must propagate to the caller instead.
+TRANSPORT_ERRORS = (ReplicaCrashed, ReplicaUnavailable, OSError,
+                    TimeoutError)
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    """Router-side replay record: everything needed to redrive a
+    request after its replica dies. ``observed`` is the token stream
+    seen so far (``committed`` — tokens already folded into the current
+    submission's prompt by an earlier cold redrive — plus the live
+    replica's progress poll)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int]
+    lane: str
+    ttft_deadline_s: Optional[float]
+    submitted_at: float
+    trace_id: int = 0
+    redrives: int = 0
+    committed: List[int] = dataclasses.field(default_factory=list)
+    observed: List[int] = dataclasses.field(default_factory=list)
+    checkpoint: Optional[Dict] = None
 
 
 class FleetRouter:
@@ -55,7 +108,8 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence, *, policy: str = "affinity",
                  registry=None, tracer=None, seed: int = 0,
-                 autoscaler=None):
+                 autoscaler=None, faults: Optional[FaultPolicy] = None,
+                 clock=time.monotonic):
         if not replicas:
             raise ValueError("need at least one replica")
         if policy not in ("affinity", "p2c", "round_robin"):
@@ -75,22 +129,96 @@ class FleetRouter:
         self._rev: Dict[tuple, int] = {}       # (id(rep), lrid) -> frid
         self._results: "OrderedDict[int, np.ndarray]" = OrderedDict()
         self._stats: "OrderedDict[int, Dict]" = OrderedDict()
+        self._rejects: "OrderedDict[int, Reject]" = OrderedDict()
         self._results_cap = 1024
         self._rr = 0                           # round-robin cursor
         self.migrations_total = 0
         self.routed_affinity_total = 0
         self.routed_balance_total = 0
+        # involuntary-failure machinery (ISSUE 14): replay records for
+        # redrive, a failure detector, and per-replica circuit breakers
+        self.faults = FaultPolicy() if faults is None else faults
+        self._clock = clock
+        self._reqs: Dict[int, _FleetRequest] = {}
+        self._detector = FailureDetector(
+            max_consecutive_failures=self.faults.max_consecutive_failures,
+            probe_timeout_s=self.faults.probe_timeout_s)
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        self.breaker_transitions: List[tuple] = []  # (replica, old, new)
+        self.ejected_total = 0
+        self.redrives_total = 0
 
     # -- placement ---------------------------------------------------------
 
     def _load(self, rep) -> float:
-        h = rep.health()
+        try:
+            h = rep.health()
+        except NotImplementedError:
+            raise
+        except Exception:
+            if not self.faults.enabled:
+                raise               # PR 9 contract: health errors surface
+            return float("inf")     # unreachable: worst possible load
         return (float(h.get("queue_depth", 0))
                 + float(h.get("requests_in_flight", 0)))
 
+    def _breaker(self, rep) -> CircuitBreaker:
+        b = self._breakers.get(id(rep))
+        if b is None:
+            name = rep.name
+
+            def on_transition(old, new, trace_id, _name=name):
+                self.breaker_transitions.append((_name, old, new))
+                self._reg.gauge(
+                    "fleet_breaker_state",
+                    "per-replica circuit breaker "
+                    "(0 closed / 1 half-open / 2 open)").set(
+                        BREAKER_GAUGE[new], replica=_name)
+                self._reg.counter(
+                    "fleet_breaker_transitions_total",
+                    "circuit-breaker state transitions").inc(
+                        replica=_name, to=new)
+                if self.tracer.enabled:
+                    # on the triggering request's original trace id, so
+                    # the breaker flip lands on that request's timeline
+                    self.tracer.record_span(
+                        "fleet.breaker", duration_s=0.0,
+                        trace_id=trace_id or None, replica=_name,
+                        **{"from": old, "to": new})
+
+            b = CircuitBreaker(threshold=self.faults.breaker_threshold,
+                               cooldown_s=self.faults.breaker_cooldown_s,
+                               clock=self._clock,
+                               on_transition=on_transition)
+            self._breakers[id(rep)] = b
+        return b
+
+    def is_routable(self, rep) -> bool:
+        """Can new work land here? Draining and breaker-open replicas
+        are not routable (an open breaker past its cooldown half-opens
+        here, becoming probe-routable)."""
+        if getattr(rep, "draining", False):
+            return False
+        if not self.faults.enabled:
+            return True
+        b = self._breakers.get(id(rep))
+        if b is None:
+            return True
+        b.poll()
+        return b.state != CircuitBreaker.OPEN
+
+    def routable_count(self) -> int:
+        """Effective capacity: replicas new work can land on. The
+        autoscaler reads this — an open breaker or an ejection is lost
+        capacity a replacement spawn restores."""
+        return sum(1 for r in self.replicas if self.is_routable(r))
+
     def _candidates(self, exclude=None):
-        return [r for r in self.replicas
-                if not getattr(r, "draining", False) and r is not exclude]
+        cands = [r for r in self.replicas
+                 if not getattr(r, "draining", False) and r is not exclude]
+        if not self.faults.enabled:
+            return cands
+        return [r for r in cands if self._breaker(r).allow()]
 
     def _pick_p2c(self, cands):
         if len(cands) == 1:
@@ -103,6 +231,15 @@ class FleetRouter:
         cands = self._candidates(exclude)
         if not cands:
             raise SlotMigrationError("no routable replica")
+        if self.faults.enabled:
+            # a half-open breaker needs its probe request SENT, not
+            # left to sampling chance: route the next request there
+            # deliberately (allow() bounds it to one probe in flight)
+            for r in cands:
+                b = self._breakers.get(id(r))
+                if b is not None and b.state == CircuitBreaker.HALF_OPEN:
+                    b.note_probe()
+                    return r, 0
         if self.policy == "round_robin":
             rep = cands[self._rr % len(cands)]
             self._rr += 1
@@ -148,6 +285,7 @@ class FleetRouter:
                 "router.route", lane=lane,
                 prompt_tokens=int(prompt.shape[0]))
         trace_id = span.trace_id if span is not None else 0
+        enabled = self.faults.enabled
         tried = []
         try:
             while True:
@@ -156,14 +294,32 @@ class FleetRouter:
                         prompt, max_new_tokens, eos_id, lane=lane,
                         ttft_deadline_s=ttft_deadline_s,
                         trace_id=trace_id or None)
+                    self._note_transport_success(rep, trace_id)
                     break
                 except LoadShedError:
+                    # a shed proves the replica is ALIVE: the breaker
+                    # tracks transport health, not load
+                    if enabled:
+                        self._breaker(rep).record_success(trace_id)
                     tried.append(rep)
                     rest = [r for r in self._candidates()
                             if r not in tried]
                     if not rest:
                         if span is not None:
                             span.finish(status="shed")
+                        raise
+                    rest.sort(key=self._load)
+                    rep, hits = rest[0], 0
+                except TRANSPORT_ERRORS as e:
+                    if not enabled:
+                        raise
+                    self._note_transport_failure(rep, e, trace_id)
+                    tried.append(rep)
+                    rest = [r for r in self._candidates()
+                            if r not in tried]
+                    if not rest:
+                        if span is not None:
+                            span.finish(status="error")
                         raise
                     rest.sort(key=self._load)
                     rep, hits = rest[0], 0
@@ -174,6 +330,10 @@ class FleetRouter:
         frid = next(self._frids)
         self._where[frid] = (rep, lrid)
         self._rev[(id(rep), lrid)] = frid
+        self._reqs[frid] = _FleetRequest(
+            prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            lane=lane, ttft_deadline_s=ttft_deadline_s,
+            submitted_at=self._clock(), trace_id=trace_id)
         if trace_id:
             self._trace[frid] = trace_id
         if span is not None:
@@ -193,16 +353,167 @@ class FleetRouter:
                 "requests placed by prefix affinity").inc()
         return frid
 
+    def _note_transport_failure(self, rep, exc, trace_id: int = 0):
+        """Breaker + detector accounting for a transport-shaped
+        failure; ejects the replica when the detector declares death."""
+        self._breaker(rep).record_failure(trace_id)
+        reason = self._detector.observe_failure(rep.name, exc)
+        if reason is not None and rep in self.replicas:
+            self.eject_replica(rep, reason=reason)
+
+    def _note_transport_success(self, rep, trace_id: int = 0):
+        """EVERY successful transport interaction must feed the
+        breaker — a half-open probe can be delivered by any submit /
+        restore path (redrive included), and a success that goes
+        unrecorded leaves the breaker stuck half-open with its one
+        probe permanently in flight."""
+        if self.faults.enabled:
+            self._detector.observe_success(rep.name)
+            self._breaker(rep).record_success(trace_id)
+
+    def _probe(self, rep) -> Optional[str]:
+        """Health-probe one replica; returns a death reason or None.
+        Probe exceptions feed the circuit breaker AND count toward the
+        consecutive-failure threshold (with breaker_threshold below
+        max_consecutive_failures, a transiently flaky health endpoint
+        quarantines behind the breaker before the death verdict fires);
+        a successful probe can still carry a terminal verdict
+        (replica-surfaced loop crash, stale heartbeat with work
+        pending)."""
+        try:
+            h = rep.health()
+        except NotImplementedError:
+            raise
+        except Exception as e:
+            if not isinstance(e, ReplicaCrashed):
+                self._breaker(rep).record_failure()
+            return self._detector.observe_failure(rep.name, e)
+        return self._detector.check_health(rep.name, h)
+
+    def _poll_progress(self, rep):
+        """Record each in-flight request's emitted tokens (and newest
+        micro-checkpoint) into its replay record, so a later crash of
+        this replica cannot take the progress with it. The poll is
+        incremental — ``progress(since=...)`` returns only tokens past
+        what the record already holds, so tracking costs O(new tokens)
+        per step, not O(stream length)."""
+        rid_key = id(rep)
+        since: Dict[int, int] = {}
+        recs: Dict[int, _FleetRequest] = {}
+        for (okey, lrid), frid in self._rev.items():
+            if okey != rid_key:
+                continue
+            rec = self._reqs.get(frid)
+            if rec is not None:
+                recs[lrid] = rec
+                since[lrid] = len(rec.observed) - len(rec.committed)
+        try:
+            prog = rep.progress(since)
+            cps = rep.poll_checkpoints()
+        except NotImplementedError:
+            raise
+        except Exception:
+            return                  # dying replica: keep last knowns
+        for lrid, tail in prog.items():
+            rec = recs.get(lrid)
+            if rec is not None:
+                rec.observed.extend(int(t) for t in tail)
+        for lrid, snap in cps:
+            rec = recs.get(lrid)
+            if rec is not None:
+                rec.checkpoint = snap
+
+    def _reconcile_rejects(self, rep):
+        """A replica's engine can shed a queued request on its own
+        (TTFT deadline expired before admission). Its step() never
+        returns that rid, so without this poll the request would be
+        silently lost at the fleet level — here the engine's structured
+        verdict is lifted into ``router.reject_reason`` and the replay
+        record is cleaned."""
+        rid_key = id(rep)
+        mine = [(frid, lrid) for (okey, lrid), frid
+                in list(self._rev.items()) if okey == rid_key]
+        for frid, lrid in mine:
+            try:
+                rej = rep.reject_reason(lrid)
+            except NotImplementedError:
+                raise
+            except Exception:
+                return              # dying replica: eject path handles it
+            if rej is None:
+                continue
+            self._rev.pop((rid_key, lrid), None)
+            self._where.pop(frid, None)
+            rec = self._reqs.pop(frid, None)
+            self._rejects[frid] = rej
+            while len(self._rejects) > self._results_cap:
+                self._rejects.popitem(last=False)
+            tid = (rec.trace_id if rec is not None
+                   else self._trace.get(frid, 0))
+            self._trace.pop(frid, None)
+            self._reg.counter(
+                "fleet_replica_shed_total",
+                "requests shed by a replica's own engine after "
+                "queueing, surfaced as fleet rejects").inc(
+                    reason=rej.reason)
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "router.replica_shed", duration_s=0.0,
+                    status="shed", trace_id=tid or None,
+                    replica=rep.name, reason=rej.reason)
+
     def step(self) -> Dict[int, np.ndarray]:
         """One synchronous fleet iteration: every replica steps once;
         finished requests come back under their fleet rids. Runs the
-        autoscaler's ``tick()`` when one is attached."""
+        autoscaler's ``tick()`` when one is attached.
+
+        With ``faults.enabled`` this is also the detection loop: each
+        replica is health-probed (probe exception / replica-surfaced
+        loop crash / stale heartbeat with work pending), step
+        exceptions count toward the consecutive-failure threshold
+        (:class:`ReplicaCrashed` is immediately terminal), and a death
+        verdict triggers :meth:`eject_replica` — queued requests
+        re-route, in-flight requests redrive exactly-once."""
         finished: Dict[int, np.ndarray] = {}
+        enabled = self.faults.enabled
         for rep in list(self.replicas):
-            if rep.idle():
+            if rep not in self.replicas:
+                continue            # ejected by an earlier iteration
+            if enabled:
+                # a breaker-open replica is already quarantined: keep
+                # stepping its in-flight work but stop health-probing
+                # it, so a transient flake cannot walk the consecutive
+                # count to the death verdict while the breaker holds
+                b = self._breakers.get(id(rep))
+                if b is None or b.state != CircuitBreaker.OPEN:
+                    reason = self._probe(rep)
+                    if reason is not None:
+                        self.eject_replica(rep, reason=reason)
+                        continue
+            try:
+                if rep.idle():
+                    continue
+                out = rep.step()
+            except NotImplementedError:
+                raise
+            except Exception as e:
+                if not enabled:
+                    raise
+                self._reg.counter(
+                    "fleet_step_failures_total",
+                    "replica step()/idle() exceptions seen by the "
+                    "router").inc(replica=rep.name)
+                reason = self._detector.observe_failure(rep.name, e)
+                if reason is not None:
+                    self.eject_replica(rep, reason=reason)
                 continue
-            for lrid, toks in rep.step().items():
+            if enabled:
+                self._detector.observe_success(rep.name)
+            for lrid, toks in out.items():
                 finished.update(self._finish(rep, lrid, toks))
+            if enabled:
+                self._poll_progress(rep)
+                self._reconcile_rejects(rep)
         if self.autoscaler is not None:
             self.autoscaler.tick()
         return finished
@@ -212,9 +523,19 @@ class FleetRouter:
         if frid is None:
             return {}
         self._where.pop(frid, None)
+        rec = self._reqs.pop(frid, None)
+        if rec is not None and rec.committed:
+            # dedup on assembly (exactly-once): tokens a cold redrive
+            # folded into the resubmitted prompt come back EXACTLY once,
+            # prepended here — the peer only generated the remainder
+            toks = np.concatenate([
+                np.asarray(rec.committed, np.int32),
+                np.asarray(toks, np.int32).reshape(-1)])
         st = rep.request_stats(lrid)
         if st is not None:
             st["replica"] = rep.name
+            if rec is not None and rec.redrives:
+                st["redrives"] = rec.redrives
             self._stats[frid] = st
         rep.result(lrid)                      # drop the replica's copy
         self._results[frid] = toks
@@ -237,10 +558,28 @@ class FleetRouter:
         return out
 
     def idle(self) -> bool:
-        return all(r.idle() for r in self.replicas)
+        for r in self.replicas:
+            try:
+                if not r.idle():
+                    return False
+            except NotImplementedError:
+                raise
+            except Exception:
+                if self.faults.enabled:
+                    return False    # not idle: step() must eject it
+                raise
+        return True
 
     def result(self, frid: int) -> Optional[np.ndarray]:
         return self._results.pop(frid, None)
+
+    def reject_reason(self, frid: int) -> Optional[Reject]:
+        """Structured verdict for a request the fleet shed after
+        acceptance (redrive budget spent, deadline expired before any
+        token, or no replica left) — pop-on-read, mirroring
+        ``ServingEngine.reject_reason``. A request is NEVER silently
+        lost: it has a result or a reject."""
+        return self._rejects.pop(frid, None)
 
     def request_stats(self, frid: int) -> Optional[Dict]:
         return self._stats.pop(frid, None)
@@ -250,19 +589,41 @@ class FleetRouter:
 
     def health(self) -> Dict[str, object]:
         """Fleet-level aggregation of every replica's health snapshot
-        (the fleet ``/healthz`` payload)."""
-        per = {r.name: r.health() for r in self.replicas}
+        (the fleet ``/healthz`` payload). The fault-tolerance section
+        carries per-replica breaker states, routable capacity, and the
+        eject/redrive totals; ``degraded`` is set while any breaker is
+        open or half-open, which the exposition endpoint surfaces as
+        HTTP 503."""
+        per = {}
+        for r in self.replicas:
+            try:
+                per[r.name] = r.health()
+            except NotImplementedError:
+                raise
+            except Exception as e:
+                if not self.faults.enabled:
+                    raise           # PR 9 contract: health errors surface
+                per[r.name] = {"error": f"{type(e).__name__}: {e}"}
         occ = [float(h.get("slot_occupancy", 0.0)) for h in per.values()]
+        breakers = {r.name: self._breakers[id(r)].status()
+                    for r in self.replicas if id(r) in self._breakers}
         return {
             "replicas": len(self.replicas),
-            "queue_depth_total": sum(int(h.get("queue_depth", 0))
+            "queue_depth_total": sum(int(h.get("queue_depth", 0) or 0)
                                      for h in per.values()),
-            "requests_in_flight": sum(int(h.get("requests_in_flight", 0))
-                                      for h in per.values()),
+            "requests_in_flight": sum(
+                int(h.get("requests_in_flight", 0) or 0)
+                for h in per.values()),
             "slot_occupancy_mean": (sum(occ) / len(occ)) if occ else 0.0,
-            "recompiles": sum(int(h.get("recompiles", 0))
+            "recompiles": sum(int(h.get("recompiles", 0) or 0)
                               for h in per.values()),
             "migrations_total": self.migrations_total,
+            "routable": self.routable_count(),
+            "ejected_total": self.ejected_total,
+            "redrives_total": self.redrives_total,
+            "breakers": breakers,
+            "degraded": any(b["state"] != CircuitBreaker.CLOSED
+                            for b in breakers.values()),
             "per_replica": per,
         }
 
@@ -275,6 +636,225 @@ class FleetRouter:
         self._reg.gauge("fleet_replicas",
                         "replicas serving traffic").set(
                             len(self.replicas))
+
+    def eject_replica(self, rep, *, reason: str = "crashed") -> int:
+        """Hard removal of a dead replica — the involuntary counterpart
+        of :meth:`drain_replica`. Its KV is gone, so nothing can be
+        migrated: queued requests re-route and in-flight requests are
+        **redriven** from the router's replay records (warm-restore of
+        the newest micro-checkpoint when one exists, else resubmit
+        ``prompt + tokens-observed-so-far`` with the remaining budget).
+        Greedy decode is deterministic, so redriven outputs are
+        bit-identical to a failure-free run; requests that cannot be
+        redriven (budget spent, deadline expired, no replica left) shed
+        with a structured :class:`~paddle_tpu.serving.Reject` — never
+        silently lost. Returns the number of requests redriven or
+        shed."""
+        if rep not in self.replicas:
+            return 0
+        rep.draining = True         # never a redrive target
+        victims = [(frid, lrid)
+                   for (okey, lrid), frid in list(self._rev.items())
+                   if okey == id(rep)]
+        for frid, lrid in victims:
+            self._rev.pop((id(rep), lrid), None)
+            self._where.pop(frid, None)
+        self.replicas.remove(rep)
+        self.ejected_total += 1
+        self._breakers.pop(id(rep), None)
+        self._reg.counter(
+            "fleet_ejected_total",
+            "replicas declared dead and removed").inc(
+                reason=reason.split(":", 1)[0])
+        self._reg.gauge("fleet_replicas",
+                        "replicas serving traffic").set(
+                            len(self.replicas))
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.eject", duration_s=0.0, replica=rep.name,
+                reason=reason, requests=len(victims))
+        try:
+            rep.close()             # best-effort: it is already dead
+        except Exception:
+            pass
+        for frid, _lrid in victims:
+            self._redrive(frid, src=rep.name)
+        return len(victims)
+
+    def _redrive(self, frid: int, *, src: str = "?"):
+        """Exactly-once redrive of one request whose replica died."""
+        rec = self._reqs.get(frid)
+        if rec is None:             # already finished or never recorded
+            self._trace.pop(frid, None)
+            return
+        tid = rec.trace_id or self._trace.get(frid, 0)
+        observed = list(rec.observed)
+        # the observed stream may already be complete (the replica died
+        # between emitting the last token and reporting the finish):
+        # deliver it directly, exactly once
+        if rec.eos_id is not None and rec.eos_id in observed:
+            observed = observed[:observed.index(rec.eos_id) + 1]
+            return self._finish_from_observed(frid, rec, observed, src)
+        if len(observed) >= rec.max_new_tokens:
+            return self._finish_from_observed(
+                frid, rec, observed[:rec.max_new_tokens], src)
+        rec.redrives += 1
+        if rec.redrives > self.faults.max_redrives:
+            return self._shed_redrive(frid, rec, "redrive_budget", src)
+        # deadline awareness: once the first token was observed the TTFT
+        # deadline is already met; before that, an expired deadline
+        # sheds with a structured reason instead of redriving a request
+        # nobody is waiting for
+        deadline = None
+        if rec.ttft_deadline_s is not None and not observed:
+            dl_at = rec.submitted_at + rec.ttft_deadline_s
+            now = self._clock()
+            if now > dl_at:
+                return self._shed_redrive(frid, rec, "deadline_expired",
+                                          src)
+            deadline = dl_at - now
+        # warm path: restore the newest micro-checkpoint into a peer —
+        # KV travels, only the post-checkpoint tail re-decodes
+        if rec.checkpoint is not None:
+            snap, rec.checkpoint = rec.checkpoint, None  # consume once
+            span = None
+            if self.tracer.enabled:
+                span = self.tracer.start_span(
+                    "router.redrive", trace_id=tid or None, mode="warm",
+                    src=src, tokens_observed=len(observed))
+            for peer in sorted(self._candidates(), key=self._load):
+                try:
+                    nrid = peer.restore(snap, parent_span=span)
+                except NotImplementedError:
+                    raise
+                except Exception:
+                    continue        # corrupt / no capacity / dying peer
+                self._note_transport_success(peer, tid)
+                self._where[frid] = (peer, nrid)
+                self._rev[(id(peer), nrid)] = frid
+                # the restored slot carries its generated tokens; the
+                # observed stream continues from the snapshot's state
+                rec.observed = list(rec.committed) + [
+                    int(t) for t in snap["state"]["generated"]]
+                self.redrives_total += 1
+                self._reg.counter(
+                    "fleet_redrive_total",
+                    "in-flight requests redriven after replica "
+                    "death").inc(mode="warm")
+                if span is not None:
+                    span.set_attrs(dst=peer.name)
+                    span.finish()
+                return
+            if span is not None:
+                span.finish(status="fallback_cold")
+        # cold path: resubmit prompt + observed as the new prompt with
+        # the remaining budget — greedy determinism makes the
+        # continuation identical to the uninterrupted run
+        if observed:
+            new_prompt = np.concatenate([
+                rec.prompt, np.asarray(observed, np.int32)])
+        else:
+            new_prompt = rec.prompt
+        remaining = rec.max_new_tokens - len(observed)
+        try:
+            first, _hits = self._route(new_prompt)
+        except SlotMigrationError:
+            return self._shed_redrive(frid, rec, "no_replica", src)
+        others = sorted((r for r in self._candidates() if r is not first),
+                        key=self._load)
+        last_shed: Optional[LoadShedError] = None
+        for peer in [first] + others:
+            try:
+                nrid = peer.submit(new_prompt, remaining, rec.eos_id,
+                                   lane=rec.lane,
+                                   ttft_deadline_s=deadline,
+                                   trace_id=tid or None)
+            except LoadShedError as e:
+                # alive but loaded: close-probe accounting, then move on
+                if self.faults.enabled:
+                    self._breaker(peer).record_success(tid)
+                last_shed = e
+                continue
+            except NotImplementedError:
+                raise
+            except TRANSPORT_ERRORS as e:
+                self._note_transport_failure(peer, e, tid)
+                continue
+            except Exception:
+                continue            # dying peer: its own probe ejects it
+            self._note_transport_success(peer, tid)
+            self._where[frid] = (peer, nrid)
+            self._rev[(id(peer), nrid)] = frid
+            rec.committed = list(observed)
+            rec.observed = list(observed)
+            self.redrives_total += 1
+            self._reg.counter(
+                "fleet_redrive_total",
+                "in-flight requests redriven after replica death").inc(
+                    mode="cold")
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "router.redrive", duration_s=0.0,
+                    trace_id=tid or None, mode="cold", src=src,
+                    dst=peer.name, tokens_observed=len(observed),
+                    remaining=remaining)
+            return
+        reason = (last_shed.reject.reason if last_shed is not None
+                  else "no_replica")
+        return self._shed_redrive(frid, rec, reason, src)
+
+    def _finish_from_observed(self, frid, rec, observed, src):
+        toks = np.asarray(observed, np.int32)
+        self._results[frid] = toks
+        while len(self._results) > self._results_cap:
+            self._results.popitem(last=False)
+        self._reqs.pop(frid, None)
+        self._trace.pop(frid, None)
+        self.redrives_total += 1
+        self._reg.counter(
+            "fleet_redrive_total",
+            "in-flight requests redriven after replica death").inc(
+                mode="observed")
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.redrive", duration_s=0.0,
+                trace_id=(rec.trace_id or None), mode="observed",
+                src=src, tokens_observed=len(observed))
+
+    def _shed_redrive(self, frid, rec, reason: str, src: str):
+        """A request the fleet cannot redrive sheds with a structured
+        verdict (surfaced via :meth:`reject_reason`) — the no-silent-
+        loss contract."""
+        self._rejects[frid] = Reject(reason, rec.lane, 0, 0.0, 0.001)
+        while len(self._rejects) > self._results_cap:
+            self._rejects.popitem(last=False)
+        self._reqs.pop(frid, None)
+        self._trace.pop(frid, None)
+        self._reg.counter(
+            "fleet_redrive_shed_total",
+            "redrives shed with a structured reason").inc(reason=reason)
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.redrive", duration_s=0.0, status="shed",
+                trace_id=(rec.trace_id or None), src=src, reason=reason)
+
+    def _drain_crashed(self, rep, exc: BaseException) -> int:
+        """A replica died mid-drain: fall through to eject + redrive
+        (nothing is lost — queued requests already re-routed, in-flight
+        requests redrive from the replay records)."""
+        if not self.faults.enabled:
+            raise exc
+        self._reg.counter(
+            "fleet_drain_crash_total",
+            "replicas that died mid-drain (fell through to "
+            "eject + redrive)").inc()
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.drain_crashed", duration_s=0.0,
+                replica=rep.name,
+                error=f"{type(exc).__name__}: {exc}")
+        return self.eject_replica(
+            rep, reason=f"crashed_mid_drain:{type(exc).__name__}")
 
     def drain_replica(self, rep, *, remove: bool = True) -> int:
         """Live-drain one replica: stop admitting, re-route its queued
@@ -295,7 +875,13 @@ class FleetRouter:
         # fleet-wide verdict); a request EVERY peer sheds is dropped
         # with its fleet bookkeeping cleaned — the same outcome a
         # direct submit to a saturated fleet would have had.
-        for (lrid, prompt, mnew, eos, lane, dl) in rep.drain_queue():
+        try:
+            queued = rep.drain_queue()
+        except NotImplementedError:
+            raise
+        except Exception as e:
+            return self._drain_crashed(rep, e)
+        for (lrid, prompt, mnew, eos, lane, dl) in queued:
             frid = self._rev.pop((id(rep), lrid), None)
             trace_id = self._trace.get(frid, 0) if frid else 0
             first, _hits = self._route(prompt, exclude=rep)
@@ -307,14 +893,29 @@ class FleetRouter:
                     nrid = peer.submit(prompt, mnew, eos, lane=lane,
                                        ttft_deadline_s=dl,
                                        trace_id=trace_id or None)
+                    self._note_transport_success(peer, trace_id or 0)
                     target = peer
                     break
                 except LoadShedError:
+                    continue
+                except TRANSPORT_ERRORS as e:
+                    if not self.faults.enabled:
+                        raise
+                    self._note_transport_failure(peer, e,
+                                                 trace_id or 0)
                     continue
             if nrid is None:
                 if frid is not None:
                     self._where.pop(frid, None)
                     self._trace.pop(frid, None)
+                    rec = self._reqs.pop(frid, None)
+                    # structured verdict, never silence: the caller can
+                    # distinguish "shed everywhere" from "still running"
+                    self._rejects[frid] = Reject(
+                        "requeue_shed", rec.lane if rec else lane,
+                        0, 0.0, 0.001)
+                    while len(self._rejects) > self._results_cap:
+                        self._rejects.popitem(last=False)
                 self._reg.counter(
                     "fleet_requeue_shed_total",
                     "drain re-routes shed by every remaining replica"
@@ -333,7 +934,17 @@ class FleetRouter:
                     trace_id=trace_id or None, src=rep.name,
                     dst=target.name)
         migrated = 0
-        snaps = rep.snapshot_inflight()
+        # the drain-vs-crash race: a replica that dies HERE — after its
+        # queue was handed over but before migration completes — must
+        # not take the in-flight requests with it. The failure falls
+        # through to the eject path, which redrives them from the
+        # router's replay records.
+        try:
+            snaps = rep.snapshot_inflight()
+        except NotImplementedError:
+            raise
+        except Exception as e:
+            return self._drain_crashed(rep, e)
         for pos, (lrid, snap) in enumerate(snaps):
             frid = self._rev.pop((id(rep), lrid), None)
             span = None
@@ -348,9 +959,15 @@ class FleetRouter:
             for peer in peers:
                 try:
                     nrid = peer.restore(snap, parent_span=span)
+                    self._note_transport_success(peer)
                     target = peer
                     break
                 except SlotMigrationError:
+                    continue
+                except TRANSPORT_ERRORS as e:
+                    if not self.faults.enabled:
+                        raise
+                    self._note_transport_failure(peer, e)
                     continue
             if nrid is None:
                 # nowhere to put it: give this one AND every remaining
@@ -419,6 +1036,14 @@ class FleetMonitor:
         g("fleet_requests_in_flight",
           "admitted requests across the fleet").set(
               h["requests_in_flight"])
+        g("fleet_routable_replicas",
+          "replicas new work can land on (breaker-closed, "
+          "not draining)").set(h.get("routable", h["replicas"]))
+        for name, bs in (h.get("breakers") or {}).items():
+            g("fleet_breaker_state",
+              "per-replica circuit breaker "
+              "(0 closed / 1 half-open / 2 open)").set(
+                  BREAKER_GAUGE[bs["state"]], replica=name)
         occ, util, burn = [], [], []
         for name, rh in h["per_replica"].items():
             occ.append(float(rh.get("slot_occupancy", 0.0)))

@@ -323,6 +323,58 @@ class TestRetry:
                        policy=RetryPolicy(max_attempts=4, base_delay_s=0.0))
         assert len(calls) == 4
 
+    def test_exhaustion_counted_on_attempts_path(self):
+        """Give-up is its own signal: retries_total alone cannot tell a
+        limping dependency from a limping-then-DEAD one."""
+        from paddle_tpu import observability
+
+        def always_fails():
+            raise IOError("nope")
+
+        cnt = observability.counter("resilience_retry_exhausted_total")
+        before = cnt.value(op="exh_attempts")
+        with pytest.raises(IOError):
+            retry_call(always_fails, op="exh_attempts",
+                       policy=RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.0))
+        assert cnt.value(op="exh_attempts") == before + 1
+
+    def test_exhaustion_counted_on_deadline_path(self):
+        from paddle_tpu import observability
+
+        def always_fails():
+            raise IOError("nope")
+
+        cnt = observability.counter("resilience_retry_exhausted_total")
+        before = cnt.value(op="exh_deadline")
+        fake_now = itertools.count(0, 10)   # each attempt "takes" 10s
+        with pytest.raises(IOError):
+            retry_call(always_fails, op="exh_deadline",
+                       policy=RetryPolicy(max_attempts=100,
+                                          deadline_s=25.0,
+                                          base_delay_s=0.001),
+                       sleep=lambda s: None,
+                       clock=lambda: float(next(fake_now)))
+        assert cnt.value(op="exh_deadline") == before + 1
+
+    def test_success_never_counts_exhaustion(self):
+        from paddle_tpu import observability
+
+        attempts = []
+
+        def flaky_then_ok():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        cnt = observability.counter("resilience_retry_exhausted_total")
+        before = cnt.value(op="exh_ok")
+        assert retry_call(flaky_then_ok, op="exh_ok",
+                          policy=RetryPolicy(max_attempts=5,
+                                             base_delay_s=0.0)) == "ok"
+        assert cnt.value(op="exh_ok") == before
+
     def test_non_retryable_propagates_immediately(self):
         calls = []
 

@@ -21,6 +21,49 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def validate_chaos_section(chaos: dict) -> None:
+    """Schema self-check for BENCH_ROUTER.json's ``chaos`` section
+    (ISSUE 14): every key present, correctly typed, and the
+    fault-tolerance invariants pinned — 0 silently lost requests,
+    redrive parity, at least one ejection + redrive, a completed
+    breaker cycle, and zero recompiles with detection + breakers
+    armed. Raises ValueError with a precise message otherwise."""
+    types = {
+        "lost_requests": int, "redrive_parity": bool, "redrives": int,
+        "redriven_requests": int, "shed_structured": int,
+        "ejected": int, "goodput_tokens_per_sec": (int, float),
+        "goodput_no_chaos": (int, float), "goodput_ratio": (int, float),
+        "breaker_cycle_ok": bool, "breaker_transitions": list,
+        "recompiles": int,
+    }
+    if not isinstance(chaos, dict):
+        raise ValueError(f"chaos section is {type(chaos).__name__}, "
+                         "not an object")
+    for key, t in types.items():
+        if key not in chaos:
+            raise ValueError(f"chaos section missing {key!r}")
+        if not isinstance(chaos[key], t) or isinstance(chaos[key], bool) \
+                and t is not bool:
+            raise ValueError(
+                f"chaos[{key!r}] is {type(chaos[key]).__name__}, "
+                f"want {t}")
+    if chaos["lost_requests"] != 0:
+        raise ValueError(f"chaos lost {chaos['lost_requests']} requests "
+                         "silently (must be 0)")
+    if not chaos["redrive_parity"]:
+        raise ValueError("chaos redrive_parity is false — redriven "
+                         "outputs diverged from the failure-free run")
+    if chaos["ejected"] < 1 or chaos["redrives"] < 1:
+        raise ValueError("chaos leg ejected/redrove nothing — the "
+                         "injection is dead")
+    if not chaos["breaker_cycle_ok"]:
+        raise ValueError("breaker never completed "
+                         "open->half_open->closed")
+    if chaos["recompiles"] != 0:
+        raise ValueError(f"chaos leg recompiled {chaos['recompiles']}x "
+                         "with breakers armed (must be 0)")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("path", help="JSONL log to validate")

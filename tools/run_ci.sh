@@ -91,8 +91,13 @@ print(f"serving dryrun prefill+SLO+trace metrics OK ({n} trace events)")
 # scales across 1/2/4 replicas, a mid-decode drain migrates in-flight
 # requests with byte-identical greedy outputs, zero recompiles
 # fleet-wide, and the trace artifact shows one request crossing the
-# fleet (router.route / serving.request / router.migrate share ids)
-echo "== bench smoke (router dryrun) =="
+# fleet (router.route / serving.request / router.migrate share ids).
+# The chaos stage (ISSUE 14) additionally kills a replica mid-burst and
+# flakes another's transport: 0 requests silently lost, redriven
+# outputs byte-identical, the circuit breaker completes a visible
+# open -> half_open -> closed cycle, 0 recompiles with breakers armed
+# (schema pinned by tools/check_metrics_log.py:validate_chaos_section).
+echo "== bench smoke (router + chaos dryrun) =="
 ROUTER_OUT="$(python bench.py --model router --dryrun)"
 if echo "$ROUTER_OUT" | grep -q '"error"'; then
   echo "router bench dryrun failed: $ROUTER_OUT"
@@ -100,12 +105,13 @@ if echo "$ROUTER_OUT" | grep -q '"error"'; then
 fi
 echo "$ROUTER_OUT" | python -c '
 import json, sys
+sys.path.insert(0, "tools")
 r = json.load(sys.stdin)
 for k in ("replica_scaling", "scaling_2x", "scaling_4x",
           "ttft_interactive_p99_s", "ttft_slo_met", "migrations",
           "migration_parity_ok", "affinity_routed",
           "prefix_tokens_shared", "recompiles_after_warmup",
-          "trace_json", "trace_spans"):
+          "trace_json", "trace_spans", "chaos"):
     assert k in r, f"BENCH_ROUTER missing {k}"
 assert set(r["replica_scaling"]) == {"1", "2", "4"}
 assert r["migration_parity_ok"], "drained run diverged from clean run"
@@ -114,14 +120,21 @@ assert r["recompiles_after_warmup"] == 0, "fleet recompiled"
 assert r["affinity_routed"] >= 1, "prefix affinity never fired"
 assert r["prefix_tokens_shared"] > 0, "affinity saved no prefill"
 assert r["ttft_slo_met"], "interactive probe TTFT blew the budget"
+from check_metrics_log import validate_chaos_section
+validate_chaos_section(r["chaos"])
+assert r["chaos"]["lost_requests"] == 0
+assert r["chaos"]["redrive_parity"] is True
+assert r["chaos"]["breaker_cycle_ok"] is True
+assert r["chaos"]["recompiles"] == 0
 from paddle_tpu.observability import tracing
 trace = json.load(open(r["trace_json"]))
 tracing.chrome_trace_valid(trace, require_events=1)
 names = {e["name"] for e in trace["traceEvents"]}
 for needed in ("router.route", "serving.request", "router.migrate",
-               "migrated_in", "migrated_out"):
+               "migrated_in", "migrated_out", "router.eject",
+               "router.redrive", "fleet.breaker"):
     assert needed in names, f"router trace missing {needed!r}"
-print("router dryrun fleet metrics OK")
+print("router + chaos dryrun fleet metrics OK")
 '
 
 # embedding-serving bench smoke: the device-cached host-KV lookup engine
