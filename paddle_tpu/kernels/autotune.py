@@ -356,6 +356,17 @@ def seed_default_buckets(tuner: KernelTuner) -> Dict[str, str]:
         for seed in (0, 1, 2):     # 3 shape buckets per kernel
             args, kwargs = spec.sample_inputs(seed)
             seeded[seed_entry(tuner, spec, args, kwargs)] = name
+        # tp-local twins: the tp-sharded wrappers dispatch THIS kernel
+        # per shard at H/tp head counts — those buckets must resolve
+        # from the committed manifest too, or every tp mesh starts on
+        # an unseeded prior
+        for variant in spec.tune_sample_variants:
+            for seed in (0, 1, 2):
+                sample = variant(seed)
+                if sample is None:
+                    continue       # head count not divisible by this tp
+                v_args, v_kwargs = sample
+                seeded[seed_entry(tuner, spec, v_args, v_kwargs)] = name
     return seeded
 
 

@@ -85,7 +85,12 @@ def contract_findings(spec, tuner=None) -> List[Finding]:
                 fix="extend block_candidates or fix the prior")
 
     if spec.parity_fn is not None:
-        return out    # mesh kernels: the battery orchestrates the rest
+        # mesh kernels: the parity battery orchestrates the numerics;
+        # the donation contract and the DECLARED-collective lowering
+        # (e.g. the tp wrappers' single attention-output all_reduce)
+        # are still verified on the probe's real sharded lowering
+        _donation_findings(spec, bad, check_collectives=True)
+        return out
 
     # 3. lax fallback and Pallas body agree on abstract output
     abstract = _abstract(args)
@@ -122,26 +127,64 @@ def contract_findings(spec, tuner=None) -> List[Finding]:
         bad(f"cost lowering failed: {type(e).__name__}: {e}")
 
     # 5. donation contract vs real HLO aliasing
+    _donation_findings(spec, bad, check_collectives=False)
+    return out
+
+
+def _donation_findings(spec, bad, *, check_collectives):
+    """Lower the kernel's donation probe and verify (a) the contract's
+    donatable buffers really alias in HLO — ``tf.aliasing_output`` on a
+    single-device lowering, ``jax.buffer_donor`` under SPMD (the
+    partitioner defers the aliasing decision, jax marks the donor) —
+    and (b), for mesh kernels, that EXACTLY the contract's declared
+    collective kinds lower (the tp wrappers' "one attention-output
+    collective" assertion). A mesh probe returning None means the box
+    cannot host the mesh: skipped, not failed."""
     if spec.contract.donatable and spec.donation_probe is None:
         bad("contract declares donatable buffers but registers no "
             "donation_probe to verify them against lowered HLO")
-    if spec.donation_probe is not None:
-        try:
-            fn, pargs, donate = spec.donation_probe()
-            txt = jax.jit(fn, donate_argnums=donate).lower(
-                *pargs).as_text()
-            aliased = txt.count("tf.aliasing_output")
-            if aliased < len(donate):
-                bad(f"contract marks {spec.contract.donatable} "
-                    f"donation-safe but the lowered probe aliases only "
-                    f"{aliased}/{len(donate)} donated buffers",
-                    fix="something in the kernel breaks XLA's aliasing "
-                        "(e.g. a dtype round-trip); fix it or drop the "
-                        "donatable declaration")
-        except Exception as e:
-            bad(f"donation probe failed to lower: "
-                f"{type(e).__name__}: {e}")
-    return out
+    if spec.donation_probe is None:
+        return
+    try:
+        probe = spec.donation_probe()
+    except Exception as e:
+        bad(f"donation probe construction failed: "
+            f"{type(e).__name__}: {e}")
+        return
+    if probe is None:      # mesh kernel on a too-small box
+        return
+    fn, pargs, donate = probe
+    try:
+        txt = jax.jit(fn, donate_argnums=donate).lower(
+            *pargs).as_text()
+        aliased = (txt.count("tf.aliasing_output")
+                   + txt.count("jax.buffer_donor"))
+        if aliased < len(donate):
+            bad(f"contract marks {spec.contract.donatable} "
+                f"donation-safe but the lowered probe aliases only "
+                f"{aliased}/{len(donate)} donated buffers",
+                fix="something in the kernel breaks XLA's aliasing "
+                    "(e.g. a dtype round-trip); fix it or drop the "
+                    "donatable declaration")
+    except Exception as e:
+        bad(f"donation probe failed to lower: "
+            f"{type(e).__name__}: {e}")
+        return
+    if not check_collectives:
+        return
+    try:
+        from paddle_tpu.analysis import estimate_cost
+        cost = estimate_cost(fn, *_abstract(pargs), name=spec.name)
+        kinds = sorted(cost.collective_kinds())
+        declared = sorted(set(spec.contract.collectives))
+        if kinds != declared:
+            bad(f"probe lowers collective kinds {kinds}, contract "
+                f"declares exactly {declared}",
+                fix="a sharded kernel's collective set IS its contract: "
+                    "fix the kernel or the declaration")
+    except Exception as e:
+        bad(f"probe collective lowering failed: "
+            f"{type(e).__name__}: {e}")
 
 
 # ---------------------------------------------------------------------------

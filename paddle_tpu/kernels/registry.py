@@ -64,6 +64,12 @@ class KernelContract:
     #: display/reference only.
     block_candidates: Mapping[str, Tuple[int, ...]] = \
         dataclasses.field(default_factory=dict)
+    #: collective kinds the kernel's lowering may emit (mesh kernels —
+    #: e.g. the tp-sharded serving wrappers declare ("all_reduce",),
+    #: the one attention-output collective). The kernel-contract lint
+    #: lowers the donation probe and asserts EXACTLY these kinds
+    #: appear; () keeps the single-device zero-collective contract.
+    collectives: Tuple[str, ...] = ()
     #: parity-battery tolerances (pallas-interpret vs lax vs reference)
     atol: float = 1e-5
     rtol: float = 1e-5
@@ -103,9 +109,19 @@ class KernelSpec:
     #: the static prior picks the largest candidate that fits budget
     vmem_estimate: Optional[Callable[..., int]] = None
     #: optional ``() -> (fn, args, donate_argnums)`` probe lowered by the
-    #: lint rule to verify the donation contract in real HLO
-    donation_probe: Optional[Callable[[], Tuple[Callable, tuple,
-                                                Tuple[int, ...]]]] = None
+    #: lint rule to verify the donation contract in real HLO (and, for
+    #: mesh kernels, that exactly the contract's declared ``collectives``
+    #: lower). A mesh kernel's probe may return None when the box cannot
+    #: host the mesh (single-device CI) — the check is skipped, not failed
+    donation_probe: Optional[Callable[[], Optional[Tuple[
+        Callable, tuple, Tuple[int, ...]]]]] = None
+    #: extra ``seed -> (args, kwargs) | None`` sample factories the
+    #: offline ``--seed`` CLI tunes IN ADDITION to ``sample_inputs`` —
+    #: the tp-sharded wrappers dispatch this kernel per shard at H/tp
+    #: head counts, and these keep the committed manifest covering
+    #: those buckets (None = the variant does not apply to that seed)
+    tune_sample_variants: Tuple[Callable[[int], Optional[Tuple[
+        tuple, dict]]], ...] = ()
     #: optional custom parity check ``(seed) -> {impl: max_abs_err}``
     #: (mesh kernels need their own orchestration)
     parity_fn: Optional[Callable[[int], Dict[str, float]]] = None

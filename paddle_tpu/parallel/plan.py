@@ -175,3 +175,39 @@ def megatron_plan() -> ShardingPlan:
     """Honor per-layer TP hints (Linear declares Megatron col/row specs);
     everything else replicated."""
     return ShardingPlan()
+
+
+def serving_tp_plan() -> ShardingPlan:
+    """Specs for the serving engine's head-major tensor-parallel param
+    layout (``ServingEngine(mesh=...)``, ISSUE 15): the fused qkv
+    weight reshaped ``(D, 3, H, Dh)`` is column-sharded over "tp" on
+    the HEAD axis and the output projection reshaped ``(H, Dh, D)`` is
+    row-sharded — the canonical SpecLayout qkv-col / attn-out-row
+    Megatron split (SNIPPETS.md), applied at head granularity because a
+    raw ``(D, 3D)`` column shard would straddle the q/k/v boundaries.
+    Everything else (embeddings, layer norms, MLP, logits) is
+    replicated: decode is KV-bandwidth-bound, and keeping the MLP
+    replicated is what holds the sharded step to ONE collective — the
+    psum at each layer's attention output."""
+    return ShardingPlan(rules=[
+        (r"attn/qkv_tp/weight$", P(None, None, "tp", None)),
+        (r"attn/qkv_tp/bias$", P(None, "tp", None)),
+        (r"attn/out_tp/weight$", P("tp", None, None)),
+        (r"^", P()),      # everything else replicated
+    ])
+
+
+def paged_pool_specs(pages) -> list:
+    """PartitionSpec pytree for a :class:`~paddle_tpu.serving
+    .PagedKVCache` page pool under tp: K/V page arrays sharded over
+    "tp" on the head axis (per-shard pools), int8 scale rows replicated
+    (per-token scales are head-global — see ``quantize_kv``'s
+    ``psum_axis``). Mirrors the pool's per-layer tuple structure, so it
+    drops straight into ``shard_map`` in/out specs."""
+    kv = P(None, None, "tp", None)
+    out = []
+    for ent in pages:
+        specs = [kv, kv]
+        specs.extend(P() for _ in ent[2:])      # int8 scale rows
+        out.append(tuple(specs))
+    return out

@@ -64,6 +64,21 @@ draft-prefill / verify buckets so steady state still compiles nothing
 prefix sharing (the draft must prefill every prompt token) and slot
 migration (the draft cache is not carried in snapshots).
 
+Tensor parallel (ISSUE 15): ``mesh=`` (or the shorthand ``tp=N``)
+shards the whole paged stack over the mesh's ``tp`` axis — the page
+pools hold per-shard head slices (``H/tp``), both fixed-shape steps run
+under ``shard_map`` with head-major Megatron param slices
+(``parallel/plan.serving_tp_plan``) and ONE ``psum`` per layer at the
+attention output (the only collective: MLP/embeddings stay replicated —
+decode is KV-bandwidth-bound, and the KV term is what tp divides).
+Greedy tokens are identical to the tp=1 engine (int8 pools pmax each
+token's abs-max so quantization matches bit-for-bit), slot migration
+moves one sha256 shard per (page, tp shard), ``health()`` reports the
+mesh shape, and ``warmup()`` covers the same bucket plan — zero
+steady-state recompiles with tp on. ``tp_probe=True`` builds the
+bench's busy-time vehicle: ONE shard's local computation on one device,
+collectives elided.
+
 Scheduling is SLO-aware by default (``scheduler_policy="slo"``):
 priority lanes, TTFT deadlines with earliest-deadline-first boosting,
 no head-of-line blocking (bounded-skip anti-starvation), and load
@@ -161,7 +176,9 @@ class ServingEngine:
                  slo_windows=(60.0, 300.0),
                  draft_model=None, draft_params=None, spec_k: int = 4,
                  draft_cache_dtype=None,
-                 snapshot_every_blocks: Optional[int] = None):
+                 snapshot_every_blocks: Optional[int] = None,
+                 mesh=None, tp: Optional[int] = None,
+                 tp_probe: bool = False):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
@@ -172,6 +189,51 @@ class ServingEngine:
         self.attn_impl = attn_impl
         self.prefill_chunk = int(prefill_chunk)
         self.decode_block = max(int(decode_block), 1)
+        # -- tensor parallel (ISSUE 15): heads sharded H/tp over the
+        # mesh's "tp" axis — per-shard page pools, both jitted steps
+        # under shard_map with ONE psum at each layer's attention
+        # output (the MLP/embeddings stay replicated: decode is
+        # KV-bandwidth-bound, and that is what holds the sharded step
+        # to a single collective kind). ``tp_probe=True`` instead runs
+        # ONE shard's local computation on a single device with the
+        # collectives elided — the bench's per-chip busy-time vehicle
+        # (its outputs lack the other shards' head contributions).
+        from paddle_tpu.core import mesh as mesh_lib
+        mesh_tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None \
+            else None
+        if mesh is not None and tp is not None and int(tp) != mesh_tp:
+            raise ValueError(f"tp={tp} disagrees with the mesh's tp "
+                             f"axis ({mesh_tp})")
+        if mesh is not None:
+            tp = mesh_tp
+        tp = int(tp or 1)
+        if tp_probe:
+            if tp < 2:
+                raise ValueError("tp_probe needs tp >= 2")
+            mesh = None            # one shard's work, one device
+        elif tp > 1 and mesh is None:
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tp={tp} needs {tp} devices, have {len(devs)}")
+            mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(tp=tp),
+                                      devices=devs[:tp])
+        if tp > 1:
+            if cfg.num_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide num_heads={cfg.num_heads}")
+            if draft_model is not None:
+                raise ValueError(
+                    "speculative decoding does not compose with tensor "
+                    "parallelism (the draft cache is single-device)")
+        # a mesh whose tp axis is 1 adds nothing here — drop it so
+        # health()'s chip accounting cannot read replication-only axes
+        # (dp etc.) as serving capacity
+        self.mesh = mesh if tp > 1 else None
+        self.tp = tp
+        self.tp_probe = bool(tp_probe)
+        self.tp_spmd = self.mesh is not None and tp > 1
+        self._tp_heads = cfg.num_heads // tp
         # -- speculative decoding (ISSUE 13): a draft model proposes
         # spec_k tokens per slot per round; the target verifies them all
         # in ONE fixed-shape batched-prefill-shaped step
@@ -211,12 +273,16 @@ class ServingEngine:
         # fp32 scales and attends through the dequant-attend kernels —
         # HBM per live token roughly halves AGAIN vs bf16
         dtype = cache_dtype or params["wte"]["weight"].dtype
+        # a probe engine's pool holds ONE shard's head slice; an spmd
+        # engine's pool is globally shaped but placed sharded H/tp
         self.cache = PagedKVCache(PagedCacheConfig(
-            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            num_layers=cfg.num_layers,
+            num_heads=self._tp_heads if self.tp_probe else cfg.num_heads,
             head_dim=cfg.hidden_size // cfg.num_heads,
             num_slots=num_slots, page_size=page_size, num_pages=num_pages,
             max_pages_per_slot=max_pages_per_slot, dtype=dtype,
-            share_prefix=prefix_sharing))
+            share_prefix=prefix_sharing),
+            mesh=mesh if self.tp_spmd else None)
         self.quantized = self.cache.config.quantized
         self.draft_cache = None
         self._draft_quantized = False
@@ -272,10 +338,51 @@ class ServingEngine:
                 windows=slo_windows, registry=self._reg,
                 tracer=self.tracer)
 
-        self.decode_step = jax.jit(self._decode_step_impl,
-                                   donate_argnums=(1,))
-        self.prefill_step = jax.jit(self._prefill_step_impl,
-                                    donate_argnums=(1,))
+        # step-side params: tp re-lays the attention projections out
+        # head-major (qkv (D,3,H,Dh) col-sharded, out (H,Dh,D)
+        # row-sharded — parallel/plan.serving_tp_plan, the SpecLayout
+        # Megatron split at head granularity); tp=1 uses the model's
+        # own tree untouched
+        if self.tp > 1:
+            from paddle_tpu.parallel import plan as plan_lib
+            tp_params = self._make_tp_params(params)
+            if self.tp_spmd:
+                self._param_specs = plan_lib.serving_tp_plan() \
+                    .params_specs(tp_params)
+                self._step_params = jax.device_put(
+                    tp_params,
+                    plan_lib.named_shardings(mesh, self._param_specs))
+            else:                  # probe: shard 0's local slice
+                self._step_params = self._tp_shard_slice(tp_params, 0)
+            # don't pin the caller's unsharded attention projections
+            # for the engine's lifetime next to their sharded copies:
+            # under tp, self.params IS the step-side (re-laid-out,
+            # sharded) tree
+            self.params = self._step_params
+        else:
+            self._step_params = params
+        if self.tp_spmd:
+            from jax.sharding import PartitionSpec as PSpec
+
+            from paddle_tpu.core.compat import shard_map
+            from paddle_tpu.parallel import plan as plan_lib
+            rep = PSpec()
+            self._page_specs = plan_lib.paged_pool_specs(self.cache.pages)
+            step_specs = (self._param_specs, self._page_specs,
+                          rep, rep, rep, rep)
+            self.decode_step = jax.jit(shard_map(
+                self._decode_step_impl, mesh=mesh, in_specs=step_specs,
+                out_specs=(rep, self._page_specs), check_vma=False),
+                donate_argnums=(1,))
+            self.prefill_step = jax.jit(shard_map(
+                self._prefill_step_impl, mesh=mesh, in_specs=step_specs,
+                out_specs=(rep, self._page_specs), check_vma=False),
+                donate_argnums=(1,))
+        else:
+            self.decode_step = jax.jit(self._decode_step_impl,
+                                       donate_argnums=(1,))
+            self.prefill_step = jax.jit(self._prefill_step_impl,
+                                        donate_argnums=(1,))
         if self.speculative:
             # draft pages donate into their own steps; the verify step
             # donates the TARGET pages exactly like prefill does
@@ -448,6 +555,13 @@ class ServingEngine:
             "requests_in_flight": len(self.scheduler.active_slots()),
             "steps": int(self._reg.counter(
                 "serving_steps_total").value()),
+            # mesh shape (ISSUE 15): the autoscaler and /healthz must
+            # distinguish a 4-chip tp replica from a 1-chip one. The
+            # chip count is the TP degree, not the raw mesh size — a
+            # dp axis only replicates this engine's work
+            "tp": self.tp,
+            "mesh_devices": self.tp if self.tp_spmd else 1,
+            "tp_probe": self.tp_probe,
         }
         if self.slo_monitor is not None:
             h["slo"] = self.slo_monitor.status()
@@ -563,7 +677,7 @@ class ServingEngine:
                 int(self.cache.lengths[i]) + n) for i in dslots))
         t0 = time.monotonic()
         out, self.cache.pages = self.decode_step(
-            self.params, self.cache.pages,
+            self._step_params, self.cache.pages,
             jnp.asarray(self.cache.block_tables[:, :w]),
             jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
             jnp.asarray(active))
@@ -647,7 +761,7 @@ class ServingEngine:
         # chain never blocks on a host round-trip; the props transfer
         # below overlaps the verify compute
         ver, self.cache.pages = self.verify_step(
-            self.params, self.cache.pages,
+            self._step_params, self.cache.pages,
             jnp.asarray(self.cache.block_tables[:, :w]),
             jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
             props_dev, nv_dev)
@@ -893,7 +1007,7 @@ class ServingEngine:
                 for j in range(len(pslots))))
             t0 = time.monotonic()
             nxt, self.cache.pages = self.prefill_step(
-                self.params, self.cache.pages,
+                self._step_params, self.cache.pages,
                 jnp.asarray(bt_rows[:, :w]),
                 jnp.asarray(starts), jnp.asarray(tokens), jnp.asarray(nv))
             if self.speculative:
@@ -1068,7 +1182,7 @@ class ServingEngine:
         for sig in self.warmup_plan():
             if sig[0] == "decode":
                 w = sig[1]
-                args = (self.params, self.cache.pages,
+                args = (self._step_params, self.cache.pages,
                         jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
                         zeros)
                 if cost_gauges:
@@ -1085,7 +1199,7 @@ class ServingEngine:
                 _, self.draft_cache.pages = self.draft_propose_step(*args)
             elif sig[0] == "verify":
                 w = sig[1]
-                args = (self.params, self.cache.pages,
+                args = (self._step_params, self.cache.pages,
                         jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
                         jnp.zeros((s_tot, self.spec_k), jnp.int32),
                         zeros)
@@ -1095,7 +1209,7 @@ class ServingEngine:
             elif sig[0] == "prefill":
                 w, sb = sig[1], sig[2]
                 zb = jnp.zeros((sb,), jnp.int32)
-                args = (self.params, self.cache.pages,
+                args = (self._step_params, self.cache.pages,
                         jnp.zeros((sb, w), jnp.int32), zb,
                         jnp.zeros((sb, self.prefill_chunk), jnp.int32),
                         zb)
@@ -1191,19 +1305,30 @@ class ServingEngine:
             src, dst = pc
             pids = [src if p == dst else p for p in pids]
         shards, manifest = [], []
+        hl = self._tp_heads
         for k, pid in enumerate(pids):
             page = self.read_page_step(self.cache.pages,
                                        jnp.asarray(pid, jnp.int32))
             if self.quantized:
-                shard = (np.asarray(page[0]), np.asarray(page[1]))
+                kv_all, sc_all = np.asarray(page[0]), np.asarray(page[1])
             else:
-                shard = np.asarray(page)
-            shards.append(shard)
-            manifest.append({
-                "index": k,
-                "sha256": self._shard_digest(shard),
-                "bytes": self._shard_bytes(shard),
-            })
+                kv_all, sc_all = np.asarray(page), None
+            # per-shard shards (ISSUE 15): one sha256-digested shard per
+            # (page, tp shard) — the head axis of (2, L, ps, H, Dh) cut
+            # at mesh-shard boundaries, so each shard's KV travels and
+            # verifies independently (an int8 shard carries the
+            # replicated scale rows alongside — one hash over both, as
+            # before)
+            for t in range(self.tp if self.tp_spmd else 1):
+                kv_t = kv_all[..., t * hl:(t + 1) * hl, :]
+                shard = (kv_t, sc_all) if self.quantized else kv_t
+                shards.append(shard)
+                manifest.append({
+                    "index": k,
+                    "tp_shard": t,
+                    "sha256": self._shard_digest(shard),
+                    "bytes": self._shard_bytes(shard),
+                })
         root = self._req_spans.get(req.rid)
         trace_id = (root.trace_id if root is not None
                     else self._ext_trace.get(req.rid, 0))
@@ -1214,7 +1339,8 @@ class ServingEngine:
                          "num_heads": cfgc.num_heads,
                          "head_dim": cfgc.head_dim,
                          "page_size": cfgc.page_size,
-                         "dtype": str(jnp.dtype(cfgc.dtype))},
+                         "dtype": str(jnp.dtype(cfgc.dtype)),
+                         "tp": self.tp if self.tp_spmd else 1},
             "request": {"prompt": np.asarray(req.prompt, np.int32),
                         "max_new_tokens": req.max_new_tokens,
                         "eos_id": req.eos_id, "lane": req.lane,
@@ -1347,8 +1473,11 @@ class ServingEngine:
         geo = snap["geometry"]
         mine = {"num_layers": cfgc.num_layers, "num_heads": cfgc.num_heads,
                 "head_dim": cfgc.head_dim, "page_size": cfgc.page_size,
-                "dtype": str(jnp.dtype(cfgc.dtype))}
+                "dtype": str(jnp.dtype(cfgc.dtype)),
+                "tp": self.tp if self.tp_spmd else 1}
         if geo != mine:
+            # cross-tp restore is refused like any other geometry
+            # mismatch: the shard layout IS part of the transfer format
             raise SlotMigrationError(
                 f"cache geometry mismatch: snapshot {geo} != engine {mine}")
         shards, manifest = snap["shards"], snap["manifest"]
@@ -1374,11 +1503,14 @@ class ServingEngine:
         # null page other live requests gather from
         length = int(snap["state"]["length"])
         n_live = cfgc.pages_for(length) if length > 0 else 0
-        if length < 0 or length > total or len(shards) != n_live:
+        tp_shards = self.tp if self.tp_spmd else 1
+        if length < 0 or length > total or \
+                len(shards) != n_live * tp_shards:
             raise SlotMigrationError(
-                f"{len(shards)} shards for {length} live tokens of a "
-                f"{total}-token reservation — snapshot state "
-                "inconsistent, refusing to restore")
+                f"{len(shards)} shards for {length} live tokens "
+                f"({tp_shards} per page) of a {total}-token "
+                "reservation — snapshot state inconsistent, refusing "
+                "to restore")
         if not self.cache.can_reserve(total):
             raise SlotMigrationError(
                 f"no page capacity for {total} tokens")
@@ -1387,17 +1519,24 @@ class ServingEngine:
         # carried KV into every live page, so the slot must own them all
         self.cache.reserve(slot, total)
         stt = snap["state"]
-        for k, shard in enumerate(shards):
+        for k in range(n_live):
+            # reassemble each page from its tp shards: hash-verified
+            # head-axis chunks concatenated back in mesh-shard order
+            chunks = shards[k * tp_shards:(k + 1) * tp_shards]
             dst = int(self.cache.block_tables[slot, k])
             if self.quantized:
-                kv, sc = shard
+                kv = np.concatenate([np.asarray(c[0]) for c in chunks],
+                                    axis=3)
+                sc = chunks[0][1]
                 self.cache.pages = self.write_page_step(
                     self.cache.pages, jnp.asarray(dst, jnp.int32),
                     jnp.asarray(kv), jnp.asarray(sc))
             else:
+                kv = np.concatenate([np.asarray(c) for c in chunks],
+                                    axis=3)
                 self.cache.pages = self.write_page_step(
                     self.cache.pages, jnp.asarray(dst, jnp.int32),
-                    jnp.asarray(shard))
+                    jnp.asarray(kv))
         self.cache.lengths[slot] = int(stt["length"])
         rid = next(self.scheduler._ids)     # fresh local rid, no collision
         req = Request(rid, prompt, int(rq["max_new_tokens"]),
@@ -1432,11 +1571,93 @@ class ServingEngine:
         self._refresh_health()
         return rid
 
+    # -- tensor parallel helpers ------------------------------------------
+
+    def _make_tp_params(self, params):
+        """Head-major TP re-layout of the attention projections: fused
+        qkv weight ``(D, 3D)`` -> ``(D, 3, H, Dh)`` (bias ``(3D,)`` ->
+        ``(3, H, Dh)``), out_proj weight ``(D, D)`` -> ``(H, Dh, D)``.
+        Sharding the RAW fused columns over tp would hand each shard a
+        slice straddling the q/k/v boundaries; head-major, the "tp"
+        shard boundary IS a head boundary — which is exactly what the
+        per-shard page pools need. Everything else passes through
+        untouched (replicated under ``serving_tp_plan``)."""
+        cfg = self.model.cfg
+        d, h = cfg.hidden_size, cfg.num_heads
+        dh = d // h
+        out = dict(params)
+        blocks = {}
+        for name, bp in params["blocks"].items():
+            bp = dict(bp)
+            qkv, op = bp["attn"]["qkv_proj"], bp["attn"]["out_proj"]
+            attn = {
+                "qkv_tp": {"weight": qkv["weight"].reshape(d, 3, h, dh)},
+                "out_tp": {"weight": op["weight"].reshape(h, dh, d)},
+            }
+            if "bias" in qkv:
+                attn["qkv_tp"]["bias"] = qkv["bias"].reshape(3, h, dh)
+            if "bias" in op:
+                attn["out_tp"]["bias"] = op["bias"]
+            bp["attn"] = attn
+            blocks[name] = bp
+        out["blocks"] = blocks
+        return out
+
+    def _tp_shard_slice(self, tp_params, shard: int):
+        """One shard's local slice of the head-major TP tree — the
+        probe engine's params (what shard_map would hand shard
+        ``shard``)."""
+        hl = self._tp_heads
+        lo = shard * hl
+        out = dict(tp_params)
+        blocks = {}
+        for name, bp in tp_params["blocks"].items():
+            bp = dict(bp)
+            attn = dict(bp["attn"])
+            qkv = {"weight": attn["qkv_tp"]["weight"][:, :, lo:lo + hl]}
+            if "bias" in attn["qkv_tp"]:
+                qkv["bias"] = attn["qkv_tp"]["bias"][:, lo:lo + hl]
+            attn["qkv_tp"] = qkv
+            op = {"weight": attn["out_tp"]["weight"][lo:lo + hl]}
+            if "bias" in attn["out_tp"]:
+                op["bias"] = attn["out_tp"]["bias"]
+            attn["out_tp"] = op
+            bp["attn"] = attn
+            blocks[name] = bp
+        out["blocks"] = blocks
+        return out
+
+    def _qkv_tp(self, ap, x):
+        """``(S, C, D)`` -> per-shard q, k, v heads ``(S, H/tp, C,
+        Dh)`` from the head-major projection slice (the col-parallel
+        half of the Megatron split)."""
+        qkv = jnp.einsum("scd,dthk->tshck", x, ap["qkv_tp"]["weight"])
+        b = ap["qkv_tp"].get("bias")
+        if b is not None:
+            qkv = qkv + b[:, None, :, None, :]
+        return qkv[0], qkv[1], qkv[2]
+
+    def _proj_tp(self, ap, att, spmd):
+        """Row-sharded output projection + THE one attention-output
+        collective: local heads ``(S, H/tp, Dh)`` (decode) or ``(S, C,
+        H/tp, Dh)`` (prefill) -> ``(S, C, D)`` replicated. ``spmd=False``
+        (the probe engine) elides the psum — one shard's partial sum
+        stands in, which is exactly one chip's share of the work."""
+        wo = ap["out_tp"]["weight"]
+        if att.ndim == 3:
+            part = jnp.einsum("shk,hkd->sd", att, wo)[:, None, :]
+        else:
+            part = jnp.einsum("schk,hkd->scd", att, wo)
+        if spmd:
+            part = jax.lax.psum(part, "tp")
+        b = ap["out_tp"].get("bias")
+        return part + b if b is not None else part
+
     # -- jitted step bodies ----------------------------------------------
 
     def _decode_loop(self, params, pages, block_tables, lengths, tokens,
                      active, n_valid=None, *, model=None, quantized=False,
-                     n_steps=1):
+                     n_steps=1, tp=1, spmd=False):
         """The shared greedy token loop behind the decode step AND the
         draft-proposal step: ``n_steps`` inner iterations, each entering
         every slot's current token at position ``lengths[s]``, landing
@@ -1446,10 +1667,16 @@ class ServingEngine:
         pages only. ``n_valid`` (draft proposing) additionally masks
         writes of iterations ``j >= n_valid[s]`` to the null page — a
         chunk capped below ``n_steps`` must not write past the slot's
-        reservation. The keyword-only args are static config (default-
-        marked so the AST host-sync lint, which runs on THIS body via
-        the graph_lint preset, seeds only the array args as tracers).
-        Returns (tokens (S, n_steps), pages)."""
+        reservation. ``tp > 1``: the body is per-shard — qkv from the
+        head-major TP slice, K/V landing in the per-shard pages, the
+        ragged kernel over ``H/tp`` local heads, and the row-sharded
+        output projection with ONE psum per layer (``spmd=False`` is
+        the probe engine: same local math, collectives elided; int8
+        scales complete their abs-max with a pmax so quantization stays
+        bit-identical to tp=1). The keyword-only args are static config
+        (default-marked so the AST host-sync lint, which runs on THIS
+        body via the graph_lint preset, seeds only the array args as
+        tracers). Returns (tokens (S, n_steps), pages)."""
         cfg = model.cfg
         ps = self.cache.config.page_size
         s_tot = tokens.shape[0]
@@ -1472,11 +1699,18 @@ class ServingEngine:
             for i, block in enumerate(model.blocks):
                 bp = params["blocks"][str(i)]
                 h = block.ln1(bp["ln1"], x)
-                q, k, v = block.attn.qkv_heads(bp["attn"], h)   # (S,H,1,Dh)
+                if tp > 1:
+                    q, k, v = self._qkv_tp(bp["attn"], h)  # (S,Hl,1,Dh)
+                else:
+                    q, k, v = block.attn.qkv_heads(bp["attn"],
+                                                   h)      # (S,H,1,Dh)
                 if quantized:
                     kp, vp, ksc, vsc = pages[i]
-                    kq, k_s = quantize_kv(k[:, :, 0, :], (1, 2))
-                    vq, v_s = quantize_kv(v[:, :, 0, :], (1, 2))
+                    psa = "tp" if (tp > 1 and spmd) else None
+                    kq, k_s = quantize_kv(k[:, :, 0, :], (1, 2),
+                                          psum_axis=psa)
+                    vq, v_s = quantize_kv(v[:, :, 0, :], (1, 2),
+                                          psum_axis=psa)
                     kp = kp.at[page_idx, off].set(kq)
                     vp = vp.at[page_idx, off].set(vq)
                     ksc = ksc.at[page_idx, off].set(k_s)
@@ -1495,8 +1729,11 @@ class ServingEngine:
                         q[:, :, 0, :], kp, vp, block_tables, lengths + 1,
                         impl=self.attn_impl)                    # (S,H,Dh)
                     new_pages.append((kp, vp))
-                x = x + block.attn.proj_out(bp["attn"],
-                                            att[:, :, None, :])
+                if tp > 1:
+                    x = x + self._proj_tp(bp["attn"], att, spmd)
+                else:
+                    x = x + block.attn.proj_out(bp["attn"],
+                                                att[:, :, None, :])
                 x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
             x = model.ln_f(params["ln_f"], x)
             logits = jnp.einsum("bd,vd->bv", x[:, 0],
@@ -1527,7 +1764,8 @@ class ServingEngine:
         return self._decode_loop(params, pages, block_tables, lengths,
                                  tokens, active, model=self.model,
                                  quantized=self.quantized,
-                                 n_steps=self.decode_block)
+                                 n_steps=self.decode_block,
+                                 tp=self.tp, spmd=self.tp_spmd)
 
     def _draft_propose_step_impl(self, params, pages, block_tables,
                                  lengths, tokens, active, n_valid):
@@ -1544,7 +1782,7 @@ class ServingEngine:
 
     def _prefill_loop(self, params, pages, block_tables, starts, tokens,
                       n_valid, *, model=None, quantized=False,
-                      all_positions=False):
+                      all_positions=False, tp=1, spmd=False):
         """The shared chunk-forward behind the batched prefill step, the
         draft prefill step, and the speculative VERIFY step: ``tokens``
         (S, C) enter at absolute positions ``starts[s]..starts[s]+C-1``
@@ -1555,9 +1793,10 @@ class ServingEngine:
         slot's LAST valid position (prefill's first generated token);
         ``all_positions=True`` returns the greedy argmax after EVERY
         chunk position (S, C) — the speculative verifier's per-candidate
-        target tokens. Keyword-only args are static config (the AST
-        host-sync lint runs on this body — see :meth:`_decode_loop`).
-        Returns (tokens, pages)."""
+        target tokens. ``tp``/``spmd`` shard the body per head group
+        exactly as in :meth:`_decode_loop`. Keyword-only args are static
+        config (the AST host-sync lint runs on this body — see
+        :meth:`_decode_loop`). Returns (tokens, pages)."""
         cfg = model.cfg
         ps = self.cache.config.page_size
         s_tot, c = tokens.shape
@@ -1577,13 +1816,20 @@ class ServingEngine:
         for i, block in enumerate(model.blocks):
             bp = params["blocks"][str(i)]
             h = block.ln1(bp["ln1"], x)
-            q, k, v = block.attn.qkv_heads(bp["attn"], h)       # (S,H,C,Dh)
+            if tp > 1:
+                q, k, v = self._qkv_tp(bp["attn"], h)           # (S,Hl,C,Dh)
+            else:
+                q, k, v = block.attn.qkv_heads(bp["attn"],
+                                               h)               # (S,H,C,Dh)
             k_tok = k.transpose(0, 2, 1, 3)                     # (S,C,H,Dh)
             v_tok = v.transpose(0, 2, 1, 3)
             if quantized:
                 kp, vp, ksc, vsc = pages[i]
-                kq, k_s = quantize_kv(k_tok, (2, 3))            # (S,C)
-                vq, v_s = quantize_kv(v_tok, (2, 3))
+                psa = "tp" if (tp > 1 and spmd) else None
+                kq, k_s = quantize_kv(k_tok, (2, 3),
+                                      psum_axis=psa)            # (S,C)
+                vq, v_s = quantize_kv(v_tok, (2, 3),
+                                      psum_axis=psa)
                 kp = kp.at[page_idx, off].set(kq)
                 vp = vp.at[page_idx, off].set(vq)
                 ksc = ksc.at[page_idx, off].set(k_s)
@@ -1601,8 +1847,11 @@ class ServingEngine:
                     q.transpose(0, 2, 1, 3), kp, vp, block_tables,
                     starts, n_valid, impl=self.attn_impl)       # (S,C,H,Dh)
                 new_pages.append((kp, vp))
-            x = x + block.attn.proj_out(bp["attn"],
-                                        att.transpose(0, 2, 1, 3))
+            if tp > 1:
+                x = x + self._proj_tp(bp["attn"], att, spmd)
+            else:
+                x = x + block.attn.proj_out(bp["attn"],
+                                            att.transpose(0, 2, 1, 3))
             x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
         x = model.ln_f(params["ln_f"], x)
         if all_positions:
@@ -1622,7 +1871,8 @@ class ServingEngine:
         slot's last valid position (S,), pages)."""
         return self._prefill_loop(params, pages, block_tables, starts,
                                   tokens, n_valid, model=self.model,
-                                  quantized=self.quantized)
+                                  quantized=self.quantized,
+                                  tp=self.tp, spmd=self.tp_spmd)
 
     def _draft_prefill_step_impl(self, params, pages, block_tables,
                                  starts, tokens, n_valid):

@@ -609,6 +609,11 @@ class FleetRouter:
                     for r in self.replicas if id(r) in self._breakers}
         return {
             "replicas": len(self.replicas),
+            # chips behind the fleet (ISSUE 15): a tp=4 replica is 4
+            # chips of capacity — the autoscaler and /healthz must not
+            # read it as one
+            "chips_total": sum(int(h.get("mesh_devices", 1) or 1)
+                               for h in per.values()),
             "queue_depth_total": sum(int(h.get("queue_depth", 0) or 0)
                                      for h in per.values()),
             "requests_in_flight": sum(
@@ -1031,6 +1036,9 @@ class FleetMonitor:
         g = self.reg.gauge
         g("fleet_replicas", "replicas serving traffic").set(
             h["replicas"])
+        g("fleet_chips", "accelerator chips behind the fleet "
+          "(tp-degree-weighted replica count)").set(
+              h.get("chips_total", h["replicas"]))
         g("fleet_queue_depth", "queued requests across the fleet").set(
             h["queue_depth_total"])
         g("fleet_requests_in_flight",
@@ -1054,6 +1062,9 @@ class FleetMonitor:
             g("fleet_replica_slot_occupancy",
               "per-replica decode-slot occupancy").set(
                   rh.get("slot_occupancy", 0.0), replica=name)
+            g("fleet_replica_tp",
+              "per-replica tensor-parallel degree (mesh chips)").set(
+                  rh.get("mesh_devices", 1) or 1, replica=name)
             slo = rh.get("slo")
             if slo:
                 burn.append(float(slo.get("burn_fast", 0.0)))

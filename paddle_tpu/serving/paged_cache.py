@@ -56,6 +56,17 @@ stored rows, so stored content is append-stable and prefix sharing
 stays exact). Dequantization happens INSIDE the dequant-attend kernels
 (:mod:`~paddle_tpu.serving.decode_attention`), fused into the QK and
 PV products — no fp page is ever materialized.
+
+Tensor parallel (ISSUE 15): pass ``mesh=`` (a mesh with a ``tp`` axis
+of size > 1) and the page pool becomes **per-shard**: the K/V page
+arrays are placed sharded over ``tp`` on the HEAD axis (each mesh shard
+holds every page's slice of its own ``H/tp`` heads), while the block
+tables, lengths, allocator books, and — for int8 pools — the per-token
+scale rows stay replicated (a token's quantization scale is computed
+over ALL heads, so it is shard-independent; see
+:func:`quantize_kv`'s ``psum_axis``). The host-side allocator and the
+prefix-sharing index are untouched: page identity is global, only the
+page *contents* are sharded.
 """
 
 from __future__ import annotations
@@ -64,6 +75,7 @@ import dataclasses
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -109,7 +121,7 @@ class PageOverflowError(RuntimeError):
 KV_SCALE_FLOOR = 1e-8
 
 
-def quantize_kv(x, reduce_axes: Tuple[int, ...]):
+def quantize_kv(x, reduce_axes: Tuple[int, ...], psum_axis=None):
     """Symmetric per-token int8 quantization of a K/V slab.
 
     ``x`` carries one K (or V) vector per token over its TRAILING
@@ -121,9 +133,21 @@ def quantize_kv(x, reduce_axes: Tuple[int, ...]):
     incremental page writes append-stable: a new token never forces a
     requantization of rows already stored (a single per-page scalar
     would), which is what lets shared/published int8 pages stay
-    bit-stable under prefix sharing and CoW."""
+    bit-stable under prefix sharing and CoW.
+
+    ``psum_axis`` (tensor parallel): inside ``shard_map`` each shard
+    holds only its own ``H/tp`` heads of ``x``, so the per-token abs-max
+    is completed with a ``pmax`` over the named mesh axis BEFORE the
+    scale divides — every shard then quantizes its head slice with the
+    all-head scale the tp=1 engine computes (max is exact, so for
+    bit-identical inputs the quantization is bit-identical; in the
+    sharded engine deeper layers' inputs carry the psum's last-ulp
+    accumulation noise, which the rounding absorbs — greedy parity is
+    pinned at the token level)."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=reduce_axes)
+    if psum_axis is not None:
+        amax = jax.lax.pmax(amax, psum_axis)
     scale = jnp.maximum(amax, KV_SCALE_FLOOR) / 127.0
     exp = scale.reshape(scale.shape + (1,) * len(reduce_axes))
     q = jnp.clip(jnp.round(xf / exp), -127, 127).astype(jnp.int8)
@@ -173,11 +197,21 @@ def prompt_prefix_digests(prompt, page_size: int) -> List[int]:
 
 class PagedKVCache:
     """Device pages + host-side page allocator, block tables, and the
-    refcounted prefix-sharing index."""
+    refcounted prefix-sharing index.
 
-    def __init__(self, config: PagedCacheConfig):
+    ``mesh=`` (tp > 1): the K/V page arrays are placed sharded over the
+    mesh's ``tp`` axis on the head dimension — per-shard page pools —
+    while int8 scale rows stay replicated (per-token scales are
+    head-global). Allocator/index state is host-side and unaffected."""
+
+    def __init__(self, config: PagedCacheConfig, mesh=None):
         self.config = config
+        self.mesh = mesh if (mesh is not None
+                             and int(mesh.shape.get("tp", 1)) > 1) else None
         c = config
+        if self.mesh is not None and c.num_heads % int(mesh.shape["tp"]):
+            raise ValueError(
+                f"tp={mesh.shape['tp']} must divide num_heads={c.num_heads}")
         shape = (c.num_pages, c.page_size, c.num_heads, c.head_dim)
         if c.quantized:
             # int8 pages + fp32 per-token-row scales, one (k, v, ks, vs)
@@ -193,6 +227,15 @@ class PagedKVCache:
             self.pages: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
                 (jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype))
                 for _ in range(c.num_layers)]
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            kv_s = NamedSharding(self.mesh, P(None, None, "tp", None))
+            rep = NamedSharding(self.mesh, P())
+            self.pages = [
+                tuple(jax.device_put(a, kv_s if i < 2 else rep)
+                      for i, a in enumerate(ent))
+                for ent in self.pages]
         self.block_tables = np.zeros((c.num_slots, c.max_pages_per_slot),
                                      np.int32)
         self.lengths = np.zeros((c.num_slots,), np.int32)

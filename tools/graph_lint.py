@@ -41,6 +41,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the tp serving surfaces lower under a 2-device mesh: force virtual CPU
+# devices (read at backend init, so setting it here still takes effect)
+# the way tests/conftest.py does
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -275,6 +283,59 @@ def lint_serving_prefill_int8(suppressions, cost=False):
         suppressions=suppressions, cost=cost)
 
 
+def _tiny_tp_engine(**kw):
+    """A tp=2 twin of the preset's tiny serving engine over the first
+    two virtual CPU devices (the tiny GPT has 2 heads — one per
+    shard)."""
+    from paddle_tpu.core.mesh import MeshConfig, make_mesh
+    mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    return _tiny_serving_engine(mesh=mesh, **kw)
+
+
+def lint_serving_decode_tp(suppressions, cost=False):
+    """The tensor-parallel decode step (ISSUE 15): heads sharded H/tp
+    under shard_map, per-shard page pools donated, and — under
+    ``--cost`` — the sharded-step collective contract: the
+    ``collective_allowlist`` committed in ``tools/cost_budgets.json``
+    is exactly ``["all_reduce"]``, the one attention-output psum per
+    layer (MLP/embeddings replicated emit nothing), with the
+    collective BYTES budget-gated by ``--cost-diff``."""
+    import jax.numpy as jnp
+
+    eng = _tiny_tp_engine()
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.decode_step, analysis.abstractify(eng._step_params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_decode_tp", ast_fn=eng._decode_loop,
+        suppressions=suppressions, cost=cost)
+
+
+def lint_serving_prefill_tp(suppressions, cost=False):
+    """The tensor-parallel batched-prefill step — same sharded-step
+    contract as :func:`lint_serving_decode_tp` (one attention-output
+    collective kind, budget-gated bytes)."""
+    import jax.numpy as jnp
+
+    eng = _tiny_tp_engine()
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.prefill_step, analysis.abstractify(eng._step_params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_prefill_tp", ast_fn=eng._prefill_loop,
+        suppressions=suppressions, cost=cost)
+
+
 def lint_embedding_install(suppressions, cost=False):
     """The embedding-serving cache's update step: the device hot-row
     table is DONATED into the bucketed scatter (the engine replaces its
@@ -360,7 +421,8 @@ PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
                   lint_convgroup, lint_serving_decode,
                   lint_serving_prefill, lint_serving_decode_int8,
-                  lint_serving_prefill_int8, lint_embedding_install,
+                  lint_serving_prefill_int8, lint_serving_decode_tp,
+                  lint_serving_prefill_tp, lint_embedding_install,
                   lint_embedding_lookup, lint_kernel_registry],
 }
 

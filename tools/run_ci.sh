@@ -162,6 +162,40 @@ assert r["speedup_vs_cold"] > 1.0, \
 print("embedding serving dryrun metrics OK")
 '
 
+# serving_tp bench smoke (ISSUE 15): the tensor-parallel engine must run
+# end-to-end on the virtual CPU mesh — greedy tokens bit-identical to
+# tp=1 at tp=2 AND tp=4, zero steady-state recompiles with tp on, the
+# decode step lowering exactly the one attention-output collective
+# (bytes from the CostReport), and per-chip busy-time scaling > 1
+# (the full >=1.6x acceptance gate runs non-dryrun inside the bench)
+echo "== bench smoke (serving_tp dryrun) =="
+TP_OUT="$(python bench.py --model serving_tp --dryrun)"
+if echo "$TP_OUT" | grep -q '"error"'; then
+  echo "serving_tp bench dryrun failed: $TP_OUT"
+  exit 1
+fi
+echo "$TP_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("decode_tokens_per_s", "scaling_2x", "scaling_4x", "tp",
+          "greedy_identical_all_tp", "recompiles_after_warmup"):
+    assert k in r, f"BENCH_SERVING_TP missing {k}"
+assert set(r["decode_tokens_per_s"]) == {"1", "2", "4"}
+assert r["greedy_identical_all_tp"] is True
+assert r["recompiles_after_warmup"] == 0
+for tp in ("1", "2", "4"):
+    assert r["tp"][tp]["recompiles"] == 0, (tp, r["tp"][tp])
+    assert r["tp"][tp]["greedy_identical"] is True
+assert r["tp"]["2"]["collective_bytes_per_decode_body"] > 0, \
+    "tp=2 decode step lowered no attention-output collective"
+assert r["tp"]["2"]["mesh_devices"] == 2
+assert r["tp"]["4"]["mesh_devices"] == 4
+assert r["scaling_2x"] > 1.0, \
+    "tp=2 per-chip busy time shows no scaling: %s" % r["scaling_2x"]
+print("serving_tp dryrun OK (scaling_2x=%s, scaling_4x=%s)"
+      % (r["scaling_2x"], r["scaling_4x"]))
+'
+
 # kernel-layer bench smoke: the shared autotuner must measure all three
 # single-device Pallas kernels (flash, ragged decode, ragged prefill)
 # across 3 shape buckets through ONE dispatch harness, hit its cache on
