@@ -800,7 +800,8 @@ ROUTER_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                  "prefix_tokens_shared",
                  "recompiles_after_warmup", "num_requests",
                  "replica_slots", "decode_cap",
-                 "trace_json", "trace_spans", "device", "chaos")
+                 "trace_json", "trace_spans", "device", "chaos",
+                 "headroom", "postmortem_dir")
 
 # the chaos variant's sub-schema (ISSUE 14) — shared with
 # tools/check_metrics_log.py:validate_chaos_section so CI and the bench
@@ -809,7 +810,9 @@ CHAOS_SCHEMA = ("lost_requests", "redrive_parity", "redrives",
                 "redriven_requests", "shed_structured", "ejected",
                 "goodput_tokens_per_sec", "goodput_no_chaos",
                 "goodput_ratio", "breaker_cycle_ok",
-                "breaker_transitions", "recompiles")
+                "breaker_transitions", "recompiles",
+                "postmortems", "postmortem_reasons",
+                "postmortem_valid", "postmortem_files")
 
 
 def router_json_path(dryrun: bool) -> str:
@@ -1060,9 +1063,18 @@ def run_bench_router(dev, dryrun=False):
                              probe_timeout_s=120.0,
                              breaker_threshold=2,
                              breaker_cooldown_s=0.2, max_redrives=4)
+    # flight recorder (ISSUE 16): the crash ejection must ship a
+    # schema-validated postmortem bundle next to BENCH_ROUTER.json
+    import os
+    import shutil
+    jpath = router_json_path(dryrun)
+    pm_dir = (jpath[:-5] if jpath.endswith(".json") else jpath) \
+        + ".postmortems"
+    shutil.rmtree(pm_dir, ignore_errors=True)   # this run's bundles only
     router_x = fleet.FleetRouter(
         [replicas[0], c_crash, c_flaky, replicas[3]],
-        registry=reg, tracer=tracer, seed=17, faults=fpol)
+        registry=reg, tracer=tracer, seed=17, faults=fpol,
+        postmortem_dir=pm_dir)
     for rep in replicas:
         rep.busy_s = 0.0
 
@@ -1122,6 +1134,28 @@ def run_bench_router(dev, dryrun=False):
              ("half_open", "closed")]
     it = iter(flaky_trans)
     breaker_cycle_ok = all(t in it for t in cycle)   # ordered subseq
+    # postmortem artifact gate: every ejection (and the flaky breaker
+    # opening) pulled a black box; each bundle must validate and the
+    # eject bundle's trace ids must join the redrive spans' timeline
+    bundles = router_x.postmortems()
+    redrive_tids = {s.trace_id for s in tracer.spans()
+                    if s.name == "router.redrive" and s.trace_id}
+    eject_bundles = [b for b in bundles if b["reason"] == "eject"]
+    if not eject_bundles:
+        raise RuntimeError("chaos leg: crash ejection shipped no "
+                           "postmortem bundle")
+    for b in bundles:
+        obs.validate_postmortem_bundle(b)
+    if not set(eject_bundles[0]["trace_ids"]) & redrive_tids:
+        raise RuntimeError(
+            "chaos leg: eject postmortem trace ids "
+            f"{eject_bundles[0]['trace_ids']} join no router.redrive "
+            "span — the bundle cannot be linked to its victims")
+    pm_files = sorted(os.listdir(pm_dir)) if os.path.isdir(pm_dir) else []
+    if not pm_files:
+        raise RuntimeError(f"chaos leg: no postmortem dumped to {pm_dir}")
+    for fn in pm_files:
+        obs.validate_postmortem_file(os.path.join(pm_dir, fn))
     chaos = {
         "lost_requests": int(chaos_lost),
         "redrive_parity": bool(chaos_parity),
@@ -1140,10 +1174,26 @@ def run_bench_router(dev, dryrun=False):
         "breaker_transitions": [f"{nm}:{old}->{new}" for (nm, old, new)
                                 in router_x.breaker_transitions],
         "recompiles": 0,        # re-pinned below after det.check()
+        "postmortems": len(bundles),
+        "postmortem_reasons": sorted({b["reason"] for b in bundles}),
+        "postmortem_valid": True,           # validated above, or raised
+        "postmortem_files": pm_files,
     }
 
     det.check()
     chaos["recompiles"] = det.recompiles
+
+    # --- headroom plane (ISSUE 16): the fleet monitor aggregates the
+    # surviving replicas' resource headroom (min across replicas = the
+    # fleet bottleneck) — pinned in the committed JSON so a regression
+    # in the gauge plumbing fails the bench, not a dashboard
+    monitor = fleet.FleetMonitor(router_x, registry=reg)
+    mon_h = monitor.collect()
+    headroom = mon_h["headroom"]
+    if set(headroom) != {"flops", "pages", "slots", "hbm"}:
+        raise RuntimeError(f"fleet headroom plane incomplete: {headroom}")
+    if any(not (0.0 <= float(v) <= 1.0) for v in headroom.values()):
+        raise RuntimeError(f"fleet headroom out of range: {headroom}")
 
     # --- trace artifact: the cross-replica timeline (ISSUE acceptance:
     # one trace shows a request crossing the fleet through a migration)
@@ -1162,7 +1212,6 @@ def run_bench_router(dev, dryrun=False):
                            "router.migrate to its request spans")
     chrome = tracer.to_chrome()
     obs.chrome_trace_valid(chrome, require_events=len(crossing))
-    jpath = router_json_path(dryrun)
     trace_path = (jpath[:-5] if jpath.endswith(".json") else jpath) \
         + ".trace.json"
     with open(trace_path, "w") as f:
@@ -1188,6 +1237,8 @@ def run_bench_router(dev, dryrun=False):
         "prefix_tokens_shared": int(prefix_tokens_shared),
         "recompiles_after_warmup": det.recompiles,
         "chaos": chaos,
+        "headroom": headroom,
+        "postmortem_dir": os.path.basename(pm_dir),
         "num_requests": n_req,
         "replica_slots": slots,
         "decode_cap": cap,
@@ -1972,6 +2023,12 @@ def run_bench_serving_tp(dev, dryrun=False):
     prompts = [rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
                for n in lens]
 
+    # anatomy probe cadence for the REAL sharded engines: every Nth
+    # decode round replays on the collective-elided probe jit, so the
+    # bench reports MEASURED exposed-collective time (not just the
+    # CostReport's static payload)
+    probe_every = 2 if dryrun else 8
+
     def make_engine(tp, probe=False):
         reg = obs.MetricsRegistry()
         eng = serving.ServingEngine(
@@ -1979,7 +2036,8 @@ def run_bench_serving_tp(dev, dryrun=False):
             max_tokens_per_slot=max_tokens, prefill_chunk=chunk,
             attn_impl="lax", registry=reg,
             **({} if tp == 1 else
-               {"tp": tp, "tp_probe": True} if probe else {"tp": tp}))
+               {"tp": tp, "tp_probe": True} if probe else
+               {"tp": tp, "anatomy_probe_every": probe_every}))
         eng.warmup(cost_gauges=False)
         return eng, reg
 
@@ -2049,12 +2107,24 @@ def run_bench_serving_tp(dev, dryrun=False):
         if eng.recompile_detector.recompiles:
             raise RuntimeError(f"tp={tp} engine recompiled in steady "
                                "state after warmup")
+        # step anatomy (ISSUE 16): measured collective-exposed time per
+        # decode step (real wall minus the collective-elided probe's
+        # wall, sampled), host-gap fraction, and the headroom plane
+        asum = eng.anatomy.summary()
+        health = eng.health()
         tp_info[str(tp)] = {
             "greedy_identical": True,
             "recompiles": eng.recompile_detector.recompiles,
             "collective_bytes_per_decode_body": cbytes,
             "collective_bytes_per_token": round(cbytes / num_slots, 1),
-            "mesh_devices": eng.health()["mesh_devices"],
+            "mesh_devices": health["mesh_devices"],
+            "collective_exposed_s": round(
+                float(asum.get("collective_exposed_s", 0.0)), 6),
+            "collective_exposed_frac": round(
+                float(asum.get("collective_exposed_frac", 0.0)), 4),
+            "probe_samples": int(asum.get("probe_samples", 0)),
+            "host_gap_frac": round(float(asum["host_gap_frac"]), 4),
+            "headroom": health["headroom"],
         }
         del eng
         # busy-time leg: the per-chip probe
@@ -2103,6 +2173,14 @@ def run_bench_serving_tp(dev, dryrun=False):
         assert info["greedy_identical"] is True
     assert result["tp"]["2"]["collective_bytes_per_decode_body"] > 0, \
         "tp=2 step lowered no collective — the psum is missing"
+    for tp in ("2", "4"):
+        info = result["tp"][tp]
+        assert info["probe_samples"] >= 1, \
+            f"tp={tp} anatomy probe never sampled a decode round"
+        assert info["collective_exposed_s"] >= 0.0, (tp, info)
+        assert 0.0 <= info["host_gap_frac"] <= 1.0, (tp, info)
+        assert set(info["headroom"]) >= {"flops", "pages", "slots",
+                                         "hbm"}, (tp, info)
     path = serving_tp_json_path(dryrun)
     with open(path, "w") as f:
         json.dump(result, f, indent=1)

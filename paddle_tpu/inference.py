@@ -232,6 +232,7 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
                        policy: str = "affinity", registry=None,
                        tracer=None, warmup: bool = True,
                        autoscaler=None, seed: int = 0, faults=None,
+                       postmortem_dir=None, shed_spike_threshold: int = 4,
                        **engine_kwargs):
     """Multi-replica serving front end — N continuous-batching
     :func:`make_serving_engine` replicas behind one
@@ -249,7 +250,9 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
     :class:`~paddle_tpu.serving.fleet.FaultPolicy`): crashed/hung
     replicas are detected and ejected with their requests redriven
     exactly-once, and per-replica circuit breakers pause routing to
-    transiently sick replicas. Returns the router; replicas are warmed
+    transiently sick replicas; ``postmortem_dir=`` additionally writes
+    each ejection/breaker-open flight-recorder bundle to disk (see
+    :mod:`paddle_tpu.observability.flight`). Returns the router; replicas are warmed
     (every bucket precompiled) before it is handed back unless
     ``warmup=False``."""
     from paddle_tpu import observability as _obs
@@ -268,7 +271,9 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
         reps.append(rep)
     return _fleet.FleetRouter(reps, policy=policy, registry=registry,
                               tracer=tracer, seed=seed,
-                              autoscaler=autoscaler, faults=faults)
+                              autoscaler=autoscaler, faults=faults,
+                              postmortem_dir=postmortem_dir,
+                              shed_spike_threshold=shed_spike_threshold)
 
 
 def make_embedding_serving_engine(store, model=None, params=None,

@@ -1,7 +1,7 @@
-"""Runtime telemetry subsystem: metrics, traces, and live exposition.
+"""Runtime telemetry subsystem: metrics, traces, exposition, anatomy.
 
-Three pillars (ISSUE 1 + ISSUE 10 / TensorFlow-paper-style first-class
-telemetry):
+Four pillars (ISSUE 1 + ISSUE 10 + ISSUE 16 / TensorFlow-paper-style
+first-class telemetry):
 
 1. **Metrics** (`registry.py`, `runlog.py`, `telemetry.py`,
    `recompile.py`, `aggregate.py`): process-wide named Counter / Gauge /
@@ -21,11 +21,28 @@ telemetry):
    ``/traces`` from a running process, and a multi-window burn-rate
    monitor over the latency histograms (``slo_burn_rate`` gauge,
    edge-triggered ``slo_alerts_total`` alerts into metrics AND trace).
+4. **Step anatomy + crash flight recorder** (`anatomy.py`, `flight.py`):
+   per-jitted-step wall-time decomposition (host gap, phase-split device
+   busy, host assembly, sampled collective-exposed time via the
+   ``tp_probe`` discipline) feeding histograms/gauges AND trace spans;
+   a bounded :class:`FlightRecorder` black box per replica that dumps
+   schema-validated postmortem bundles (anatomy JSONL + Chrome trace +
+   health trajectory) on eject / breaker-open / shed spikes, served
+   live at ``/debug/postmortem`` and rendered by ``tools/postmortem.py``.
 
-One :func:`report` call dumps a unified summary across all three.
+One :func:`report` call dumps a unified summary across all four.
 """
 
-from paddle_tpu.observability import exposition, slo, tracing
+from paddle_tpu.observability import anatomy, exposition, flight, slo, tracing
+from paddle_tpu.observability.anatomy import (StepAnatomy,
+                                              validate_anatomy_log,
+                                              validate_anatomy_record,
+                                              validate_anatomy_records)
+from paddle_tpu.observability.flight import (POSTMORTEM_SCHEMA,
+                                             FlightRecorder,
+                                             validate_postmortem_bundle,
+                                             validate_postmortem_file,
+                                             write_bundle)
 from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
                                                MetricsRegistry, counter,
                                                default, gauge, histogram)
@@ -89,5 +106,9 @@ __all__ = [
     "report", "render_prometheus", "snapshot", "observe_span",
     "Span", "Tracer", "validate_trace_log", "chrome_trace_valid",
     "ExpositionServer", "BurnRateMonitor",
-    "tracing", "exposition", "slo",
+    "StepAnatomy", "validate_anatomy_record", "validate_anatomy_records",
+    "validate_anatomy_log", "FlightRecorder", "POSTMORTEM_SCHEMA",
+    "validate_postmortem_bundle", "validate_postmortem_file",
+    "write_bundle",
+    "tracing", "exposition", "slo", "anatomy", "flight",
 ]

@@ -20,6 +20,12 @@ dependencies — the container rule), serving three read-only views:
                 endpoint.
   ``/traces``   recent ring-buffer spans as JSON (``?limit=N``,
                 ``?trace_id=T``), newest last.
+  ``/debug/postmortem``
+                recent flight-recorder postmortem bundles from every
+                registered provider (``?limit=N`` most recent,
+                ``?replica=NAME`` one provider) — the crash artifacts
+                the fleet router dumps on eject / breaker-open / shed
+                spikes, schema ``paddle_tpu.postmortem-v1``.
 
 Opt-in and port-0 by default: nothing binds unless a caller starts a
 server, and tests grab an ephemeral port so parallel CI runs never
@@ -61,6 +67,7 @@ class ExpositionServer:
         self._host = host
         self._want_port = int(port)
         self._health: Dict[str, Callable[[], dict]] = {}
+        self._postmortem: Dict[str, Callable[[], list]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.monotonic()
@@ -71,6 +78,15 @@ class ExpositionServer:
         returning a JSON-able dict); its output nests under ``name`` in
         the ``/healthz`` body."""
         self._health[name] = provider
+        return self
+
+    def add_postmortem(self, name: str,
+                       provider: Callable[[], list]) -> "ExpositionServer":
+        """Register a postmortem-bundle provider (a zero-arg callable
+        returning a list of bundle dicts, oldest → newest — e.g.
+        ``FleetRouter.postmortems`` or ``FlightRecorder.bundles``);
+        served under ``/debug/postmortem``."""
+        self._postmortem[name] = provider
         return self
 
     # -- lifecycle --------------------------------------------------------
@@ -149,10 +165,23 @@ class ExpositionServer:
                 payload = self.traces(limit=limit, trace_id=trace_id)
                 self._reply(h, 200, "application/json",
                             json.dumps(payload, default=str).encode())
+            elif route == "/debug/postmortem":
+                q = parse_qs(parsed.query)
+                try:
+                    limit = int(q["limit"][0]) if "limit" in q else None
+                except ValueError as e:
+                    self._reply(h, 400, "text/plain",
+                                f"bad query parameter: {e}".encode())
+                    return
+                replica = q["replica"][0] if "replica" in q else None
+                payload = self.postmortems(limit=limit, replica=replica)
+                self._reply(h, 200, "application/json",
+                            json.dumps(payload, default=str).encode())
             else:
                 self._reply(h, 404, "text/plain",
                             b"paddle_tpu exposition: "
-                            b"/metrics /healthz /traces\n")
+                            b"/metrics /healthz /traces "
+                            b"/debug/postmortem\n")
         except BrokenPipeError:
             pass                     # scraper went away mid-reply
         except Exception as e:       # never take the endpoint down
@@ -196,6 +225,28 @@ class ExpositionServer:
             "providers": providers,
         }
         return status, payload
+
+    def postmortems(self, limit: Optional[int] = None,
+                    replica: Optional[str] = None) -> dict:
+        """Recent postmortem bundles across providers, oldest → newest;
+        a provider that raises reports an error entry instead of taking
+        the endpoint down (the healthz discipline)."""
+        bundles: list = []
+        errors: Dict[str, str] = {}
+        for name, fn in self._postmortem.items():
+            if replica is not None and name != replica:
+                continue
+            try:
+                bundles.extend(fn())
+            except Exception as e:
+                errors[name] = f"{type(e).__name__}: {e}"
+        bundles.sort(key=lambda b: b.get("ts", 0.0))
+        if limit is not None and limit >= 0:
+            bundles = bundles[-limit:]
+        payload = {"count": len(bundles), "bundles": bundles}
+        if errors:
+            payload["errors"] = errors
+        return payload
 
     def traces(self, limit: Optional[int] = None,
                trace_id: Optional[int] = None) -> dict:

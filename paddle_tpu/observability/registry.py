@@ -79,6 +79,29 @@ class _Metric:
         with self._lock:
             return list(self._series)
 
+    def remove(self, **labels) -> bool:
+        """Drop one label set's series (tombstone). A scrape after this
+        no longer exports the series at all — the contract FleetMonitor
+        relies on when a replica is ejected: its per-replica gauges must
+        disappear, not freeze at their last value forever. Returns
+        whether a series was actually removed. Any ``child()`` handle
+        bound to the removed cell keeps working but writes to a
+        disconnected cell no exposition path reads."""
+        key = _label_key(labels)
+        with self._lock:
+            return self._series.pop(key, None) is not None
+
+    def remove_matching(self, **labels) -> int:
+        """Drop every series whose label set includes all the given
+        pairs (e.g. ``remove_matching(replica="r1")`` across metrics
+        that also carry other labels). Returns series removed."""
+        want = set(_label_key(labels))
+        with self._lock:
+            gone = [k for k in self._series if want <= set(k)]
+            for k in gone:
+                del self._series[k]
+            return len(gone)
+
 
 class _BoundChild:
     """A (metric, cell) pair: pre-resolved series handle."""

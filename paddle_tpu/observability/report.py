@@ -69,6 +69,10 @@ def report(reg: Optional[_registry.MetricsRegistry] = None,
                          f"{a['total_s']:>12.4f}")
         if tracer.dropped:
             lines.append(f"(ring dropped {tracer.dropped} older spans)")
+    anatomy_lines = _anatomy_lines(reg)
+    if anatomy_lines:
+        lines.append("-- anatomy --")
+        lines.extend(anatomy_lines)
     slo_lines = _slo_lines(reg)
     if slo_lines:
         lines.append("-- slo --")
@@ -76,6 +80,49 @@ def report(reg: Optional[_registry.MetricsRegistry] = None,
     if len(lines) == 1:
         lines.append("(no metrics recorded)")
     return "\n".join(lines)
+
+
+def _anatomy_lines(reg: _registry.MetricsRegistry) -> List[str]:
+    """Step-anatomy digest, when a StepAnatomy fed this registry: the
+    per-phase device-busy split, host-gap/host fractions, sampled
+    collective-exposed time, and the resource-headroom snapshot."""
+    out: List[str] = []
+    phase_h = reg.get("anatomy_phase_seconds")
+    if isinstance(phase_h, Histogram):
+        sums = {}
+        for key in phase_h.labels_seen():
+            s = phase_h.summary(**dict(key))
+            if s["count"]:
+                sums[dict(key).get("phase", "?")] = s["sum"]
+        busy = sum(sums.values())
+        if busy > 0:
+            split = " ".join(f"{p}={v / busy:.1%}"
+                             for p, v in sorted(sums.items(),
+                                                key=lambda kv: -kv[1]))
+            out.append(f"phase_split {split} (busy={busy:.4g}s)")
+    for gname, label in (("anatomy_host_gap_frac", "host_gap_frac"),
+                         ("anatomy_host_frac", "host_frac"),
+                         ("anatomy_collective_exposed_frac",
+                          "collective_exposed_frac")):
+        g = reg.get(gname)
+        if isinstance(g, Gauge) and g.labels_seen():
+            out.append(f"{label} {g.value():.4g}")
+    coll = reg.get("anatomy_collective_exposed_seconds")
+    if isinstance(coll, Histogram):
+        s = coll.summary()
+        if s["count"]:
+            out.append(f"collective_exposed mean={s['mean']:.6g}s "
+                       f"samples={s['count']}")
+    head = reg.get("serving_headroom")
+    if isinstance(head, Gauge):
+        parts = []
+        for key in sorted(head.labels_seen()):
+            labels = dict(key)
+            parts.append(f"{labels.get('resource', '?')}="
+                         f"{head.value(**labels):.3g}")
+        if parts:
+            out.append("headroom " + " ".join(parts))
+    return out
 
 
 def _slo_lines(reg: _registry.MetricsRegistry) -> List[str]:

@@ -178,7 +178,8 @@ class ServingEngine:
                  draft_cache_dtype=None,
                  snapshot_every_blocks: Optional[int] = None,
                  mesh=None, tp: Optional[int] = None,
-                 tp_probe: bool = False):
+                 tp_probe: bool = False,
+                 anatomy_probe_every: Optional[int] = None):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
@@ -337,16 +338,46 @@ class ServingEngine:
                 "serving_ttft_seconds", ttft_budget_s,
                 windows=slo_windows, registry=self._reg,
                 tracer=self.tracer)
+        # step-time anatomy (ISSUE 16): host gap / phase-split device
+        # busy / host assembly per step, plus the sampled collective-
+        # exposed probe below; the flight recorder rides along as the
+        # replica's crash black box (the router dumps it on eject)
+        self.anatomy = obs.StepAnatomy(registry=self._reg,
+                                       tracer=self.tracer)
+        self.flight = obs.FlightRecorder(
+            "engine", anatomy=self.anatomy, registry=self._reg,
+            tracer=self.tracer)
+        if anatomy_probe_every is not None and anatomy_probe_every < 0:
+            raise ValueError("anatomy_probe_every must be >= 0")
+        # collective-exposed sampling: every N decode rounds an spmd
+        # engine re-runs the SAME decode shapes through a collectives-
+        # elided probe twin (the tp_probe discipline, in-engine); the
+        # wall delta is the exposed collective time. 0 disables; the
+        # default arms it only where there ARE collectives to expose.
+        self.anatomy_probe_every = (
+            anatomy_probe_every if anatomy_probe_every is not None
+            else (64 if self.tp_spmd else 0))
+        if not self.tp_spmd:
+            self.anatomy_probe_every = 0
+        self._decode_rounds = 0
 
         # step-side params: tp re-lays the attention projections out
         # head-major (qkv (D,3,H,Dh) col-sharded, out (H,Dh,D)
         # row-sharded — parallel/plan.serving_tp_plan, the SpecLayout
         # Megatron split at head granularity); tp=1 uses the model's
         # own tree untouched
+        self._probe_params = None
+        self._probe_pages = None
         if self.tp > 1:
             from paddle_tpu.parallel import plan as plan_lib
             tp_params = self._make_tp_params(params)
             if self.tp_spmd:
+                if self.anatomy_probe_every:
+                    # the collective probe's params: shard 0's local
+                    # slice, taken host-side BEFORE the sharded
+                    # device_put consumes the tree
+                    self._probe_params = self._tp_shard_slice(
+                        tp_params, 0)
                 self._param_specs = plan_lib.serving_tp_plan() \
                     .params_specs(tp_params)
                 self._step_params = jax.device_put(
@@ -383,6 +414,14 @@ class ServingEngine:
                                        donate_argnums=(1,))
             self.prefill_step = jax.jit(self._prefill_step_impl,
                                         donate_argnums=(1,))
+        if self._probe_params is not None:
+            # collectives-elided decode twin: ONE shard's local math on
+            # one device against a dedicated zero page pool with the
+            # per-shard head slice — same shapes per width bucket, so
+            # warmup covers it and sampling stays zero-recompile
+            self._probe_pages = self._make_probe_pool()
+            self.decode_probe_step = jax.jit(
+                self._decode_probe_step_impl, donate_argnums=(1,))
         if self.speculative:
             # draft pages donate into their own steps; the verify step
             # donates the TARGET pages exactly like prefill does
@@ -433,6 +472,14 @@ class ServingEngine:
         self._ext_trace: Dict[int, int] = {}
         self.migrated_in_total = 0
         self.migrated_out_total = 0
+        # resource-headroom plane (ISSUE 16): static per-bucket flops x
+        # observed step counts vs elapsed busy time, with the best
+        # per-call rate as the utilization ceiling (the high-water mark
+        # this hardware + bucket set actually demonstrated)
+        self._busy_s = 0.0
+        self._flops_done = 0.0
+        self._flops_rate_peak = 0.0
+        self._anat_steps = 0
         # health(): a fleet router polls from ITS thread while step()
         # mutates the scheduler/cache books — the engine publishes a
         # consistent snapshot at safe points and health() only ever
@@ -565,8 +612,77 @@ class ServingEngine:
         }
         if self.slo_monitor is not None:
             h["slo"] = self.slo_monitor.status()
+        h["headroom"] = self._headroom()
         with self._health_lock:
             self._health_snap = h
+
+    def _headroom(self) -> Dict[str, float]:
+        """The resource-headroom plane (ISSUE 16): per-resource spare
+        capacity in [0, 1] — the routing signal the two-tier dispatcher
+        reads (prefill placement wants flops headroom, decode placement
+        wants page/slot headroom), published as ``serving_headroom``
+        gauges and aggregated fleet-wide by ``FleetMonitor``."""
+        util = self.cache.utilization()
+        free = len(self.scheduler.free_slots())
+        s_tot = self.scheduler.num_slots
+        cap_b = self.cache.capacity_bytes()
+        live_b = self.cache.live_bytes()
+        # flops utilization: static bucket flops actually retired per
+        # busy second, against the best per-call rate ever observed —
+        # 0.0 (full headroom) until warmup(cost_gauges=True) priced the
+        # buckets and a step ran
+        flops_util = 0.0
+        if self._busy_s > 0 and self._flops_rate_peak > 0:
+            flops_util = min(
+                (self._flops_done / self._busy_s)
+                / self._flops_rate_peak, 1.0)
+        tokens = self._reg.counter("serving_tokens_total").value()
+        saved = self._reg.counter(
+            "serving_prefix_shared_tokens_total").value()
+        head = {
+            "flops_utilization": round(flops_util, 6),
+            "flops": round(1.0 - flops_util, 6),
+            "pages": round(max(1.0 - util, 0.0), 6),
+            "slots": round(free / s_tot, 6),
+            "hbm": round(max(1.0 - (live_b / cap_b if cap_b else 0.0),
+                             0.0), 6),
+            "hbm_live_bytes": int(live_b),
+            "hbm_capacity_bytes": int(cap_b),
+            "flops_per_busy_s": (self._flops_done / self._busy_s
+                                 if self._busy_s > 0 else 0.0),
+            "prefix_saved_per_token": round(
+                saved / tokens if tokens else 0.0, 6),
+        }
+        g = self._reg.gauge(
+            "serving_headroom",
+            "spare capacity per resource (1 = idle, 0 = saturated)")
+        for res in ("flops", "pages", "slots", "hbm"):
+            g.set(head[res], resource=res)
+        self._reg.gauge(
+            "serving_flops_utilization",
+            "retired static flops per busy second / best observed rate"
+        ).set(flops_util)
+        self._reg.gauge(
+            "serving_prefix_saved_per_token",
+            "prefill tokens skipped via prefix sharing per served token"
+        ).set(head["prefix_saved_per_token"])
+        return head
+
+    def _note_busy(self, sigs, dur: float):
+        """Headroom accounting for one jitted call: busy seconds plus
+        the static flops of the bucket(s) it retired (when warmup
+        priced them)."""
+        self._busy_s += dur
+        flops = 0.0
+        for sig in sigs:
+            cost = self.bucket_costs.get(sig)
+            if cost is not None:
+                flops += cost.total_flops
+        if flops > 0:
+            self._flops_done += flops
+            if dur > 0:
+                self._flops_rate_peak = max(self._flops_rate_peak,
+                                            flops / dur)
 
     def health(self) -> Dict[str, object]:
         """Structured live health (the ``/healthz`` payload and the
@@ -590,6 +706,7 @@ class ServingEngine:
                                    tracer=self.tracer,
                                    port=port, host=host)
         srv.add_health("serving", self.health)
+        srv.add_postmortem("serving", self.flight.bundles)
         return srv.start()
 
     # -- engine loop ------------------------------------------------------
@@ -601,6 +718,9 @@ class ServingEngine:
         block, evict finished sequences. Returns ``{rid: generated
         tokens}`` for requests that finished now."""
         finished: Dict[int, np.ndarray] = {}
+        self._anat_steps += 1
+        self.anatomy.begin_step(self._anat_steps)
+        step_tokens = 0
         if isinstance(self.scheduler, SLOScheduler):
             for req in self.scheduler.shed_expired():
                 rej = Reject("deadline_expired", req.lane,
@@ -649,6 +769,7 @@ class ServingEngine:
                 kept = self._speculative_round(dslots)
             else:
                 kept = self._decode_round(dslots)
+            step_tokens += kept
             self._reg.counter("serving_tokens_total",
                               "decode tokens produced").inc(kept)
             self._reg.counter("serving_steps_total").inc()
@@ -659,7 +780,14 @@ class ServingEngine:
 
         if self.slo_monitor is not None:
             self.slo_monitor.check()
+        if prefilled_any or dslots:
+            self.anatomy.end_step(tokens=step_tokens)
+        else:
+            # an idle tick is not a serving step: recording it would
+            # count queue-empty waiting as "host gap"
+            self.anatomy.cancel_step()
         self._refresh_health()
+        self.flight.note(self._health_snap)
         return finished
 
     def _decode_round(self, dslots) -> int:
@@ -687,6 +815,25 @@ class ServingEngine:
             "serving_decode_step_seconds",
             "wall time per decode block (sync included)").observe(
                 t1 - t0)
+        self.anatomy.add_phase("decode", t0, t1)
+        self._note_busy((("decode", w),), t1 - t0)
+        self._decode_rounds += 1
+        if self.anatomy_probe_every and self._probe_pages is not None \
+                and self._decode_rounds % self.anatomy_probe_every == 0:
+            # collective-exposed sample: the SAME decode shapes through
+            # the collectives-elided probe twin (zero probe pool, shard
+            # 0's params); every shape below is a warmed
+            # ("decode_probe", w) bucket, so steady state compiles
+            # nothing — the RecompileDetector asserts it
+            p0 = time.monotonic()
+            pout, self._probe_pages = self.decode_probe_step(
+                self._probe_params, self._probe_pages,
+                jnp.asarray(self.cache.block_tables[:, :w]),
+                jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
+                jnp.asarray(active))
+            np.asarray(pout)                     # sync the probe wall
+            p1 = time.monotonic()
+            self.anatomy.set_collective(t1 - t0, p1 - p0)
         tr_on = self.tracer.enabled
         kept = 0
         for i in dslots:
@@ -766,12 +913,19 @@ class ServingEngine:
             jnp.asarray(self.cache.lengths), jnp.asarray(tokens),
             props_dev, nv_dev)
         props = np.asarray(props_dev)          # (S, spec_k) proposals
+        # the props transfer completes when the draft chain has; the
+        # clock read between the two materializations splits the round
+        # into draft/verify anatomy without changing dispatch overlap
+        t_mid = time.monotonic()
         ver = np.asarray(ver)                  # (S, spec_k) target greedy
         t1 = time.monotonic()
         self._reg.histogram(
             "serving_decode_step_seconds",
             "wall time per decode block (sync included)").observe(
                 t1 - t0)
+        self.anatomy.add_phase("draft", t0, t_mid)
+        self.anatomy.add_phase("verify", t_mid, t1)
+        self._note_busy((("draft", w), ("verify", w)), t1 - t0)
         tr_on = self.tracer.enabled
         kept = 0
         for i in dslots:
@@ -1025,6 +1179,10 @@ class ServingEngine:
                 "serving_prefill_step_seconds",
                 "wall time per batched prefill call (sync included)"
             ).observe(now - t0)
+            self.anatomy.add_phase("prefill", t0, now)
+            self._note_busy((("prefill", w, sb),)
+                            + ((("draft_prefill", w, sb),)
+                               if self.speculative else ()), now - t0)
             call_tokens = 0
             tr_on = self.tracer.enabled
             for j, i in enumerate(pslots):
@@ -1124,6 +1282,11 @@ class ServingEngine:
                 plan.append(("verify", w))
             else:
                 plan.append(("decode", w))
+                if self._probe_params is not None:
+                    # the collective probe twin samples the same width
+                    # buckets; precompiling them keeps sampling
+                    # zero-recompile in steady state
+                    plan.append(("decode_probe", w))
             for sb in counts:
                 plan.append(("prefill", w, sb))
                 if self.speculative:
@@ -1155,6 +1318,8 @@ class ServingEngine:
                      for w in widths for sb in counts}
         else:
             sigs = {("decode", w) for w in widths}
+            if self._probe_params is not None:
+                sigs |= {("decode_probe", w) for w in widths}
         sigs |= {("prefill", w, sb) for w in widths for sb in counts}
         sigs.add(("copy_page",))
         sigs.add(("page_read",))
@@ -1188,6 +1353,15 @@ class ServingEngine:
                 if cost_gauges:
                     self._bucket_cost_gauges(sig, self.decode_step, args)
                 _, self.cache.pages = self.decode_step(*args)
+            elif sig[0] == "decode_probe":
+                w = sig[1]
+                args = (self._probe_params, self._probe_pages,
+                        jnp.zeros((s_tot, w), jnp.int32), zeros, zeros,
+                        zeros)
+                if cost_gauges:
+                    self._bucket_cost_gauges(sig, self.decode_probe_step,
+                                             args)
+                _, self._probe_pages = self.decode_probe_step(*args)
             elif sig[0] == "draft":
                 w = sig[1]
                 args = (self.draft_params, self.draft_cache.pages,
@@ -1766,6 +1940,37 @@ class ServingEngine:
                                  quantized=self.quantized,
                                  n_steps=self.decode_block,
                                  tp=self.tp, spmd=self.tp_spmd)
+
+    def _make_probe_pool(self):
+        """Zero page pool for the collective probe: the real pool's
+        geometry with ONE shard's head slice (``H/tp``) on a single
+        device — what shard_map hands each shard, minus the psum. Page
+        content does not matter for timing (shapes are fixed); a zero
+        pool keeps the probe from ever touching live KV."""
+        c = self.cache.config
+        shape = (c.num_pages, c.page_size, self._tp_heads, c.head_dim)
+        pool = []
+        for _ in range(c.num_layers):
+            if self.quantized:
+                sc = jnp.zeros((c.num_pages, c.page_size), jnp.float32)
+                pool.append((jnp.zeros(shape, jnp.int8),
+                             jnp.zeros(shape, jnp.int8), sc, sc))
+            else:
+                pool.append((jnp.zeros(shape, c.dtype),
+                             jnp.zeros(shape, c.dtype)))
+        return pool
+
+    def _decode_probe_step_impl(self, params, pages, block_tables,
+                                lengths, tokens, active):
+        """The decode step's collectives-elided twin (ISSUE 16): one
+        shard's local computation with ``spmd=False`` — identical
+        shapes and math minus the per-layer psum, so ``real - probe``
+        wall time is the step's exposed collective cost."""
+        return self._decode_loop(params, pages, block_tables, lengths,
+                                 tokens, active, model=self.model,
+                                 quantized=self.quantized,
+                                 n_steps=self.decode_block,
+                                 tp=self.tp, spmd=False)
 
     def _draft_propose_step_impl(self, params, pages, block_tables,
                                  lengths, tokens, active, n_valid):

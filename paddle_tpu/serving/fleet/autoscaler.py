@@ -52,8 +52,8 @@ class FleetAutoscaler:
                  min_replicas: int = 1, max_replicas: int = 4,
                  scale_out_burn: float = 6.0, sustain_s: float = 2.0,
                  idle_occupancy: float = 0.1, idle_s: float = 5.0,
-                 cooldown_s: float = 5.0, registry=None,
-                 clock=time.monotonic):
+                 cooldown_s: float = 5.0, headroom_floor: float = 0.0,
+                 registry=None, clock=time.monotonic):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
@@ -66,6 +66,7 @@ class FleetAutoscaler:
         self.idle_occupancy = float(idle_occupancy)
         self.idle_s = float(idle_s)
         self.cooldown_s = float(cooldown_s)
+        self.headroom_floor = float(headroom_floor)
         self._clock = clock
         from paddle_tpu import observability as obs
         self._reg = registry or obs.default()
@@ -113,8 +114,23 @@ class FleetAutoscaler:
 
     def _fleet_idle(self) -> bool:
         h = self.router.health()
-        return (h["queue_depth_total"] == 0
-                and h["slot_occupancy_mean"] <= self.idle_occupancy)
+        if (h["queue_depth_total"] != 0
+                or h["slot_occupancy_mean"] > self.idle_occupancy):
+            return False
+        # headroom cross-check (ISSUE 16), opt-in via headroom_floor>0:
+        # occupancy can read idle between decode bursts while KV pages
+        # are still pinned — a replica below the page/slot/HBM headroom
+        # floor is holding live state, and draining it would migrate
+        # all of it for nothing. The default floor of 0.0 disables the
+        # veto so an operator who tuned idle_occupancy alone keeps the
+        # scale-in timing they asked for; replicas without a headroom
+        # plane pass regardless.
+        for rh in h["per_replica"].values():
+            head = rh.get("headroom") or {}
+            for res in ("pages", "slots", "hbm"):
+                if float(head.get(res, 1.0)) < self.headroom_floor:
+                    return False
+        return True
 
     # -- the periodic decision ---------------------------------------------
 

@@ -233,6 +233,11 @@ class ChaosReplica:
         self.inner.warmup()
         return self
 
+    def postmortem(self, reason, trace_ids=()):
+        # NO _check(): the whole point of a flight recorder is that a
+        # dead replica still hands over its black box
+        return self.inner.postmortem(reason, trace_ids=trace_ids)
+
     def running(self):
         return (not self.dead and not self.hung
                 and getattr(self.inner, "running", lambda: False)())

@@ -118,6 +118,14 @@ class ReplicaHandle:
     def warmup(self):
         raise NotImplementedError
 
+    def postmortem(self, reason: str, trace_ids=()) -> Optional[Dict]:
+        """Dump the replica's flight-recorder black box as a postmortem
+        bundle (``observability.flight``). Called by the router on
+        eject / breaker-open / shed spikes — AFTER the failure, so
+        implementations must not require a live engine loop. Transports
+        without a flight recorder return None."""
+        return None
+
     def close(self):
         pass
 
@@ -136,6 +144,11 @@ class LocalReplica(ReplicaHandle):
     def __init__(self, engine, name: str = "replica0"):
         self.engine = engine
         self.name = name
+        # the black box carries the replica's fleet name so a fleet-wide
+        # /debug/postmortem endpoint can attribute bundles
+        flight = getattr(engine, "flight", None)
+        if flight is not None:
+            flight.name = name
         self.busy_s = 0.0           # wall time inside step() — the
         self.steps = 0              # bench's per-accelerator cost model
         self._thread: Optional[threading.Thread] = None
@@ -211,6 +224,15 @@ class LocalReplica(ReplicaHandle):
         self.engine.warmup()
         self._last_beat = time.monotonic()
         return self
+
+    def postmortem(self, reason: str, trace_ids=()) -> Optional[Dict]:
+        # deliberately lock-free AND loop-free: the flight recorder's
+        # ring is host-side state, so a replica whose step loop already
+        # died can still testify
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return None
+        return flight.dump(reason, trace_ids=trace_ids)
 
     def progress(self, since: Optional[Dict[int, int]] = None
                  ) -> Dict[int, List[int]]:

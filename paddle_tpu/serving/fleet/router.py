@@ -51,9 +51,10 @@ under one :class:`~paddle_tpu.serving.fleet.FaultPolicy`.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -109,7 +110,9 @@ class FleetRouter:
     def __init__(self, replicas: Sequence, *, policy: str = "affinity",
                  registry=None, tracer=None, seed: int = 0,
                  autoscaler=None, faults: Optional[FaultPolicy] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 postmortem_dir: Optional[str] = None,
+                 shed_spike_threshold: int = 4):
         if not replicas:
             raise ValueError("need at least one replica")
         if policy not in ("affinity", "p2c", "round_robin"):
@@ -147,6 +150,15 @@ class FleetRouter:
         self.breaker_transitions: List[tuple] = []  # (replica, old, new)
         self.ejected_total = 0
         self.redrives_total = 0
+        # crash flight recorder (ISSUE 16): every eject / breaker-open /
+        # shed spike pulls the victim replica's black box into a bounded
+        # bundle ring (served at /debug/postmortem) and, when a dump dir
+        # is configured, onto disk for the offline renderer
+        self.postmortem_dir = postmortem_dir
+        self.shed_spike_threshold = int(shed_spike_threshold)
+        self._postmortems: "deque" = deque(maxlen=16)
+        self._sheds_since_dump = 0
+        self._postmortem_seq = 0
 
     # -- placement ---------------------------------------------------------
 
@@ -162,12 +174,18 @@ class FleetRouter:
         return (float(h.get("queue_depth", 0))
                 + float(h.get("requests_in_flight", 0)))
 
+    def _load_or_zero(self, rep) -> float:
+        """Load for witness selection: an unreachable replica must not
+        win the max() (it gets its own eject-time postmortem)."""
+        load = self._load(rep)
+        return 0.0 if load == float("inf") else load
+
     def _breaker(self, rep) -> CircuitBreaker:
         b = self._breakers.get(id(rep))
         if b is None:
             name = rep.name
 
-            def on_transition(old, new, trace_id, _name=name):
+            def on_transition(old, new, trace_id, _name=name, _rep=rep):
                 self.breaker_transitions.append((_name, old, new))
                 self._reg.gauge(
                     "fleet_breaker_state",
@@ -185,6 +203,12 @@ class FleetRouter:
                         "fleet.breaker", duration_s=0.0,
                         trace_id=trace_id or None, replica=_name,
                         **{"from": old, "to": new})
+                if new == CircuitBreaker.OPEN:
+                    # a sick-but-alive replica testifies at the moment
+                    # the fleet stops trusting it
+                    self._dump_postmortem(
+                        _rep, "breaker_open",
+                        trace_ids=(int(trace_id),) if trace_id else ())
 
             b = CircuitBreaker(threshold=self.faults.breaker_threshold,
                                cooldown_s=self.faults.breaker_cooldown_s,
@@ -626,6 +650,7 @@ class FleetRouter:
             "routable": self.routable_count(),
             "ejected_total": self.ejected_total,
             "redrives_total": self.redrives_total,
+            "postmortems": len(self._postmortems),
             "breakers": breakers,
             "degraded": any(b["state"] != CircuitBreaker.CLOSED
                             for b in breakers.values()),
@@ -678,6 +703,19 @@ class FleetRouter:
             self.tracer.record_span(
                 "router.eject", duration_s=0.0, replica=rep.name,
                 reason=reason, requests=len(victims))
+        # flight recorder: the black box comes off BEFORE close() —
+        # victim trace ids link the bundle to every redriven request's
+        # timeline
+        tids = []
+        for frid, _lrid in victims:
+            rec = self._reqs.get(frid)
+            tid = ((rec.trace_id if rec is not None else 0)
+                   or self._trace.get(frid, 0))
+            if tid:
+                tids.append(int(tid))
+        self._dump_postmortem(
+            rep, "eject", trace_ids=tids,
+            extra={"cause": reason, "victims": len(victims)})
         try:
             rep.close()             # best-effort: it is already dead
         except Exception:
@@ -685,6 +723,51 @@ class FleetRouter:
         for frid, _lrid in victims:
             self._redrive(frid, src=rep.name)
         return len(victims)
+
+    def _dump_postmortem(self, rep, reason: str, *, trace_ids=(),
+                         extra=None):
+        """Pull ``rep``'s flight-recorder black box into the router's
+        bounded bundle ring (and onto ``postmortem_dir`` when one is
+        configured, for the offline renderer). Best-effort by design:
+        postmortem capture must never turn one failure into two."""
+        try:
+            bundle = rep.postmortem(reason, trace_ids=trace_ids)
+        except NotImplementedError:
+            raise
+        except Exception:
+            bundle = None
+        if bundle is None:
+            return None
+        if extra:
+            bundle.setdefault("extra", {}).update(extra)
+        self._postmortems.append(bundle)
+        self._postmortem_seq += 1
+        self._reg.counter(
+            "fleet_postmortems_total",
+            "postmortem bundles captured by the router").inc(
+                reason=reason)
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.postmortem", duration_s=0.0, replica=rep.name,
+                reason=reason, victims=len(tuple(trace_ids)))
+        if self.postmortem_dir:
+            from paddle_tpu.observability import flight as _flight
+            try:
+                os.makedirs(self.postmortem_dir, exist_ok=True)
+                path = os.path.join(
+                    self.postmortem_dir,
+                    f"postmortem_{self._postmortem_seq:04d}_"
+                    f"{rep.name}.json")
+                _flight.write_bundle(bundle, path)
+            except OSError:
+                pass                # capture survives a full disk
+        return bundle
+
+    def postmortems(self, limit: Optional[int] = None) -> List[Dict]:
+        """Captured postmortem bundles, oldest first (bounded ring) —
+        the ``/debug/postmortem`` payload source."""
+        out = list(self._postmortems)
+        return out[-limit:] if limit else out
 
     def _redrive(self, frid: int, *, src: str = "?"):
         """Exactly-once redrive of one request whose replica died."""
@@ -842,6 +925,24 @@ class FleetRouter:
             self.tracer.record_span(
                 "router.redrive", duration_s=0.0, status="shed",
                 trace_id=(rec.trace_id or None), src=src, reason=reason)
+        # shed spike: losing requests in bulk is a fleet-level incident
+        # even when no single replica died — the busiest survivor's
+        # black box is the congestion witness
+        self._sheds_since_dump += 1
+        if (self.shed_spike_threshold
+                and self._sheds_since_dump >= self.shed_spike_threshold):
+            witness = None
+            cands = [r for r in self.replicas
+                     if not getattr(r, "draining", False)]
+            if cands:
+                witness = max(cands, key=self._load_or_zero)
+            if witness is not None:
+                self._dump_postmortem(
+                    witness, "shed_spike",
+                    trace_ids=(int(rec.trace_id),) if rec.trace_id else (),
+                    extra={"sheds": self._sheds_since_dump,
+                           "last_reason": reason, "last_src": src})
+            self._sheds_since_dump = 0
 
     def _drain_crashed(self, rep, exc: BaseException) -> int:
         """A replica died mid-drain: fall through to eject + redrive
@@ -1024,15 +1125,37 @@ class FleetMonitor:
     and :meth:`start_exposition` exposes them with the router's
     aggregated ``/healthz``."""
 
+    # per-replica labeled series collect() owns — dropped for vanished
+    # replicas so an ejected replica's last gauge values don't haunt
+    # /metrics (and dashboards) for the life of the process
+    _PER_REPLICA_METRICS = ("fleet_replica_queue_depth",
+                            "fleet_replica_slot_occupancy",
+                            "fleet_replica_tp",
+                            "fleet_replica_burn_rate",
+                            "fleet_replica_headroom",
+                            "fleet_breaker_state")
+
     def __init__(self, router: FleetRouter, registry=None):
         from paddle_tpu import observability as obs
         self.router = router
         self.reg = registry or router._reg
         self.tracer = router.tracer
         self._obs = obs
+        self._seen_replicas: set = set()
+
+    def _drop_stale(self, live) -> int:
+        dropped = 0
+        for name in self._seen_replicas - set(live):
+            for mname in self._PER_REPLICA_METRICS:
+                m = self.reg.get(mname)
+                if m is not None:
+                    dropped += m.remove_matching(replica=name)
+        self._seen_replicas = set(live)
+        return dropped
 
     def collect(self) -> Dict[str, object]:
         h = self.router.health()
+        self._drop_stale(h["per_replica"])
         g = self.reg.gauge
         g("fleet_replicas", "replicas serving traffic").set(
             h["replicas"])
@@ -1053,9 +1176,21 @@ class FleetMonitor:
               "(0 closed / 1 half-open / 2 open)").set(
                   BREAKER_GAUGE[bs["state"]], replica=name)
         occ, util, burn = [], [], []
+        head_min: Dict[str, float] = {}
         for name, rh in h["per_replica"].items():
             occ.append(float(rh.get("slot_occupancy", 0.0)))
             util.append(float(rh.get("page_utilization", 0.0)))
+            # resource-headroom plane (ISSUE 16): per-replica gauges +
+            # the fleet-level bottleneck (min across replicas) the
+            # autoscaler and /healthz read
+            for res, v in (rh.get("headroom") or {}).items():
+                if res in ("flops", "pages", "slots", "hbm"):
+                    v = float(v)
+                    g("fleet_replica_headroom",
+                      "per-replica resource headroom "
+                      "(1 = idle, 0 = saturated)").set(
+                          v, replica=name, resource=res)
+                    head_min[res] = min(head_min.get(res, 1.0), v)
             g("fleet_replica_queue_depth",
               "per-replica queued requests").set(
                   rh.get("queue_depth", 0), replica=name)
@@ -1082,6 +1217,11 @@ class FleetMonitor:
         if burn:
             g("fleet_burn_rate_max",
               "hottest replica's fast-window burn").set(max(burn))
+        for res, v in head_min.items():
+            g("fleet_headroom_min",
+              "fleet bottleneck headroom per resource "
+              "(min across replicas)").set(v, resource=res)
+        h["headroom"] = head_min
         return h
 
     def start_exposition(self, port: int = 0, host: str = "127.0.0.1"):
@@ -1093,4 +1233,5 @@ class FleetMonitor:
                                          tracer=self.tracer,
                                          port=port, host=host)
         srv.add_health("fleet", lambda: self.collect())
+        srv.add_postmortem("fleet", self.router.postmortems)
         return srv.start()

@@ -287,6 +287,26 @@ class PagedKVCache:
         cap = (self.config.num_pages - 1) * self.config.page_size
         return float(self.lengths.sum()) / cap if cap else 0.0
 
+    def bytes_per_page(self) -> int:
+        """HBM bytes one page row commits across every layer's K + V
+        pools (+ scale rows when quantized) — global bytes under tp
+        sharding (each shard holds its head slice of the same page)."""
+        total = 0
+        for layer in self.pages:
+            for arr in layer:
+                total += arr.nbytes
+        return total // self.config.num_pages
+
+    def capacity_bytes(self) -> int:
+        """HBM bytes of the allocatable pool (null page excluded)."""
+        return self.bytes_per_page() * (self.config.num_pages - 1)
+
+    def live_bytes(self) -> int:
+        """HBM bytes committed to allocated pages right now (page
+        granularity — reservations count the moment they are made,
+        which is what admission headroom must see)."""
+        return self.bytes_per_page() * self.pages_in_use
+
     def _alloc_page(self) -> int:
         if self._free:
             return self._free.pop()

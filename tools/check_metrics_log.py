@@ -4,12 +4,18 @@
 Usage:
     python tools/check_metrics_log.py RUN.jsonl [--require-steps N]
     python tools/check_metrics_log.py --trace TRACE.jsonl [--require-spans N]
+    python tools/check_metrics_log.py --anatomy ANATOMY.jsonl \
+        [--require-steps N]
+    python tools/check_metrics_log.py --postmortem BUNDLE.json
 
 Exit 0 when every record validates (and at least N step/span records
 exist); exit 1 with a precise message otherwise. The bench scripts run
 this over their own logs so malformed telemetry fails fast instead of
 polluting the BENCH_* trajectory; CI can point it at any training run
-log or trace export (``Tracer.export_jsonl``).
+log, trace export (``Tracer.export_jsonl``), step-anatomy export
+(``StepAnatomy.export_jsonl`` — schema + monotonic step ids + phase
+sums bounded by wall time), or flight-recorder postmortem bundle
+(``observability.flight.write_bundle``).
 """
 
 from __future__ import annotations
@@ -34,7 +40,9 @@ def validate_chaos_section(chaos: dict) -> None:
         "ejected": int, "goodput_tokens_per_sec": (int, float),
         "goodput_no_chaos": (int, float), "goodput_ratio": (int, float),
         "breaker_cycle_ok": bool, "breaker_transitions": list,
-        "recompiles": int,
+        "recompiles": int, "postmortems": int,
+        "postmortem_reasons": list, "postmortem_valid": bool,
+        "postmortem_files": list,
     }
     if not isinstance(chaos, dict):
         raise ValueError(f"chaos section is {type(chaos).__name__}, "
@@ -62,6 +70,15 @@ def validate_chaos_section(chaos: dict) -> None:
     if chaos["recompiles"] != 0:
         raise ValueError(f"chaos leg recompiled {chaos['recompiles']}x "
                          "with breakers armed (must be 0)")
+    if chaos["postmortems"] < 1 or not chaos["postmortem_files"]:
+        raise ValueError("chaos leg shipped no postmortem bundle — "
+                         "the flight recorder is dead")
+    if "eject" not in chaos["postmortem_reasons"]:
+        raise ValueError("chaos postmortems include no eject bundle "
+                         f"(saw {chaos['postmortem_reasons']})")
+    if not chaos["postmortem_valid"]:
+        raise ValueError("chaos postmortem bundles failed schema "
+                         "validation")
 
 
 def main(argv=None) -> int:
@@ -76,14 +93,26 @@ def main(argv=None) -> int:
     ap.add_argument("--require-spans", type=int, default=0,
                     help="with --trace: fail unless at least N span "
                          "records are present")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="validate as a step-anatomy export "
+                         "(StepAnatomy.export_jsonl schema; "
+                         "--require-steps gates the record count)")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="validate as a flight-recorder postmortem "
+                         "bundle (single JSON file)")
     args = ap.parse_args(argv)
     # a mismatched flag/mode combination must fail fast, not silently
     # validate with no minimum-count gate
+    if sum((args.trace, args.anatomy, args.postmortem)) > 1:
+        ap.error("--trace / --anatomy / --postmortem are exclusive")
     if args.trace and args.require_steps:
         ap.error("--require-steps applies to run logs; "
                  "use --require-spans with --trace")
     if args.require_spans and not args.trace:
         ap.error("--require-spans only applies with --trace")
+    if args.postmortem and args.require_steps:
+        ap.error("--require-steps does not apply to --postmortem "
+                 "(a bundle is one record)")
 
     try:
         if args.trace:
@@ -91,6 +120,15 @@ def main(argv=None) -> int:
             n = tracing.validate_trace_log(
                 args.path, require_spans=args.require_spans)
             what = "span"
+        elif args.anatomy:
+            from paddle_tpu.observability import anatomy
+            n = anatomy.validate_anatomy_log(
+                args.path, require_steps=args.require_steps)
+            what = "anatomy"
+        elif args.postmortem:
+            from paddle_tpu.observability import flight
+            flight.validate_postmortem_file(args.path)
+            n, what = 1, "postmortem bundle"
         else:
             from paddle_tpu.observability import runlog
             n = runlog.validate_run_log(args.path,

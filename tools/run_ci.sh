@@ -126,16 +126,36 @@ assert r["chaos"]["lost_requests"] == 0
 assert r["chaos"]["redrive_parity"] is True
 assert r["chaos"]["breaker_cycle_ok"] is True
 assert r["chaos"]["recompiles"] == 0
+# ISSUE 16: the resource-headroom plane (fleet bottleneck, min across
+# replicas) and the crash flight recorder must both ship
+assert set(r["headroom"]) == {"flops", "pages", "slots", "hbm"}, \
+    r["headroom"]
+for res, v in r["headroom"].items():
+    assert 0.0 <= v <= 1.0, (res, v)
+assert r["chaos"]["postmortems"] >= 1, "no postmortem bundle captured"
+assert "eject" in r["chaos"]["postmortem_reasons"], \
+    r["chaos"]["postmortem_reasons"]
+assert r["chaos"]["postmortem_valid"] is True
 from paddle_tpu.observability import tracing
 trace = json.load(open(r["trace_json"]))
 tracing.chrome_trace_valid(trace, require_events=1)
 names = {e["name"] for e in trace["traceEvents"]}
 for needed in ("router.route", "serving.request", "router.migrate",
                "migrated_in", "migrated_out", "router.eject",
-               "router.redrive", "fleet.breaker"):
+               "router.redrive", "fleet.breaker", "router.postmortem"):
     assert needed in names, f"router trace missing {needed!r}"
 print("router + chaos dryrun fleet metrics OK")
 '
+# the on-disk postmortem artifact must validate standalone (the
+# flight-recorder acceptance: every chaos-bench ejection ships a
+# schema-valid bundle the offline renderer can read)
+PM_DIR=/tmp/BENCH_ROUTER.postmortems
+test -d "$PM_DIR" || { echo "no postmortem dump dir at $PM_DIR"; exit 1; }
+for pm in "$PM_DIR"/*.json; do
+  python tools/check_metrics_log.py --postmortem "$pm"
+done
+python tools/postmortem.py "$PM_DIR" > /dev/null
+echo "postmortem artifacts OK ($(ls "$PM_DIR" | wc -l) bundle(s))"
 
 # embedding-serving bench smoke: the device-cached host-KV lookup engine
 # must run end-to-end on CPU (cache hits/misses/evictions, streaming
@@ -192,8 +212,21 @@ assert r["tp"]["2"]["mesh_devices"] == 2
 assert r["tp"]["4"]["mesh_devices"] == 4
 assert r["scaling_2x"] > 1.0, \
     "tp=2 per-chip busy time shows no scaling: %s" % r["scaling_2x"]
-print("serving_tp dryrun OK (scaling_2x=%s, scaling_4x=%s)"
-      % (r["scaling_2x"], r["scaling_4x"]))
+# ISSUE 16: the sharded engines must report MEASURED collective-exposed
+# time (tp_probe replay sampling), host-gap fraction, and the headroom
+# plane — all without steady-state recompiles (pinned above)
+for tp in ("2", "4"):
+    i = r["tp"][tp]
+    assert i["probe_samples"] >= 1, (tp, "anatomy probe never sampled")
+    assert i["collective_exposed_s"] >= 0.0, (tp, i)
+    assert 0.0 <= i["collective_exposed_frac"] <= 1.0, (tp, i)
+    assert 0.0 <= i["host_gap_frac"] <= 1.0, (tp, i)
+    assert set(i["headroom"]) >= {"flops", "pages", "slots", "hbm"}, \
+        (tp, i["headroom"])
+print("serving_tp dryrun OK (scaling_2x=%s, scaling_4x=%s, "
+      "collective_exposed_s=%s)"
+      % (r["scaling_2x"], r["scaling_4x"],
+         r["tp"]["2"]["collective_exposed_s"]))
 '
 
 # kernel-layer bench smoke: the shared autotuner must measure all three
