@@ -1293,6 +1293,382 @@ def run_bench_router(dev, dryrun=False):
     return result
 
 
+NET_SCHEMA = ("metric", "value", "unit", "vs_baseline",
+              "net_tokens_per_sec", "local_tokens_per_sec",
+              "transport_overhead_ms_per_token", "transport_parity_ok",
+              "wire_codec", "rpc_calls_total",
+              "stream_requests", "stream_partials_min",
+              "stream_ttft_p99_s", "ttft_budget_s", "ttft_slo_met",
+              "netlog", "netlog_valid", "steady_state_recompiles",
+              "chaos", "num_requests", "replica_slots", "decode_cap",
+              "device", "dryrun")
+
+# socket-chaos sub-schema (ISSUE 17): the PR 12 chaos battery run over
+# REAL processes and a real dead socket
+NET_CHAOS_SCHEMA = ("lost_requests", "redrive_parity", "redrives",
+                    "ejected", "shed_structured", "breaker_cycle_ok",
+                    "breaker_transitions", "postmortems",
+                    "postmortem_reasons", "postmortem_valid")
+
+
+def net_json_path(dryrun: bool) -> str:
+    import os
+    if dryrun:  # CI smoke must not dirty the checkout
+        return os.environ.get("PADDLE_TPU_BENCH_NET",
+                              "/tmp/BENCH_NET.json")
+    return os.environ.get(
+        "PADDLE_TPU_BENCH_NET",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_NET.json"))
+
+
+def run_bench_net_router(dev, dryrun=False):
+    """Network serving (ISSUE 17 acceptance): the fleet split across
+    REAL processes behind the wire protocol, against the in-process
+    LocalReplica fleet as baseline. Legs:
+
+    - transport: the same burst through a 2-process NetReplica fleet
+      and a 2-replica in-process fleet — bit-identical greedy outputs
+      (the ReplicaHandle contract across a socket) and the transport
+      overhead per generated token (RPC framing + checksums + syscalls);
+      each replica process must hold ZERO steady-state recompiles
+      across the burst (warmup happens server-side before the replica
+      announces itself).
+    - streaming: a FrontDoor over the net fleet; clients must observe
+      >=2 partial token deliveries per request (incremental streaming,
+      not buffer-then-flush), streamed TTFT p99 vs the stated budget,
+      and the front door's crash-safe netlog must validate (every
+      accepted rid terminated exactly once).
+    - socket chaos: the PR 12 battery over real sockets — one replica
+      process SIGSTOPped until its breaker opens, SIGCONT + cooldown
+      and the deliberate half-open probe close it (full
+      open→half_open→closed cycle); another replica process is
+      ``kill -9``'ed mid-burst — ejected on consecutive transport
+      failures, its in-flight requests redriven exactly-once with
+      bit-identical outputs, 0 requests lost, and the eject postmortem
+      dumped from the CLIENT-side flight recorder (the process that
+      could have testified is gone).
+
+    Emits BENCH_NET.json (schema self-validated) next to this file
+    (dryrun: /tmp) plus the netlog JSONL the CI validator replays."""
+    import os
+    import signal
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.serving.fleet import net
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.resilience.retry import RetryPolicy
+
+    if dryrun:
+        config = dict(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=2, ffn_size=64, max_position=64,
+                      dropout=0.0, attn_impl="xla")
+        n_req, slots, page_size, chunk, cap = 8, 2, 4, 8, 8
+        len_set = (4, 9, 12)
+        ttft_budget = 30.0   # smoke box: schema/plumbing, not latency
+        decode_block = 4
+    else:
+        # CPU measurement config: sized so THREE subprocess warmups fit
+        # a CI box; a single replica is saturated by the burst
+        config = dict(vocab_size=1024, hidden_size=256, num_layers=4,
+                      num_heads=8, ffn_size=1024, max_position=192,
+                      dropout=0.0, attn_impl="xla")
+        n_req, slots, page_size, chunk, cap = 12, 4, 16, 32, 24
+        len_set = (16, 32, 48)
+        ttft_budget = 15.0
+        decode_block = 8
+    hi = max(len_set)
+    cap_stream = 2 * cap          # long decode: >=2 partial deliveries
+    engine_kwargs = dict(num_slots=slots, page_size=page_size,
+                         max_tokens_per_slot=hi + cap_stream,
+                         prefill_chunk=chunk, decode_block=decode_block,
+                         attn_impl="lax", ttft_budget_s=ttft_budget)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config["vocab_size"],
+                            int(n)).astype(np.int32)
+               for n in rng.choice(len_set, n_req)]
+
+    reg = obs.MetricsRegistry()
+    tracer = obs.Tracer(capacity=65536)
+    leg_tel = {"steps": 0, "dt": 0.0}
+
+    def burst(router):
+        frids = [router.submit(p, cap) for p in prompts]
+        steps = 0
+        t0 = time.perf_counter()
+        while not router.idle():
+            router.step()
+            steps += 1
+            if steps > 1_000_000:
+                raise RuntimeError("net burst did not converge")
+        dt = time.perf_counter() - t0
+        leg_tel["steps"], leg_tel["dt"] = steps, dt
+        outs = [router.result(f) for f in frids]
+        if any(o is None for o in outs):
+            raise RuntimeError("net burst lost requests")
+        return outs, dt
+
+    # --- local baseline: the SAME weights/config, in-process ----------
+    cfg = GPTConfig.tiny(**config)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    def local_replica(i):
+        eng = serving.ServingEngine(model, params,
+                                    registry=obs.MetricsRegistry(),
+                                    tracer=tracer, **engine_kwargs)
+        return fleet.LocalReplica(eng, name=f"local{i}").warmup()
+
+    router_local = fleet.FleetRouter([local_replica(i) for i in (0, 1)],
+                                     registry=reg, tracer=tracer, seed=3)
+    ref_outs, local_dt = burst(router_local)
+    total_tokens = sum(len(o) for o in ref_outs)
+    local_tps = total_tokens / max(local_dt, 1e-9)
+
+    # --- spawn the replica processes (in parallel: warmup dominates) --
+    from concurrent.futures import ThreadPoolExecutor
+    names = ("netA", "netB", "netC")
+    with ThreadPoolExecutor(len(names)) as ex:
+        spawned = list(ex.map(
+            lambda nm: net.spawn_replica_server(
+                config=config, engine=engine_kwargs, seed=0, name=nm),
+            names))
+    procs = {nm: proc for nm, (proc, _a) in zip(names, spawned)}
+    addrs = {nm: addr for nm, (_p, addr) in zip(names, spawned)}
+    try:
+        # --- transport leg: 2-process fleet, bit-identical outputs ----
+        reps_net = [net.NetReplica(addrs[nm], name=nm, registry=reg)
+                    for nm in ("netA", "netB")]
+        router_net = fleet.FleetRouter(reps_net, registry=reg,
+                                       tracer=tracer, seed=3)
+        rc0 = [int(r.health().get("recompiles", 0)) for r in reps_net]
+        net_outs, net_dt = burst(router_net)
+        rc1 = [int(r.health().get("recompiles", 0)) for r in reps_net]
+        steady_recompiles = sum(b - a for a, b in zip(rc0, rc1))
+        parity_ok = all(np.array_equal(r, o)
+                        for r, o in zip(ref_outs, net_outs))
+        net_tps = total_tokens / max(net_dt, 1e-9)
+        overhead_ms = (net_dt - local_dt) / max(total_tokens, 1) * 1e3
+        rpc_calls = sum(r.calls_total for r in reps_net)
+
+        # --- streaming leg: FrontDoor over the net fleet --------------
+        jpath = net_json_path(dryrun)
+        netlog = (jpath[:-5] if jpath.endswith(".json") else jpath) \
+            + ".netlog.jsonl"
+        if os.path.exists(netlog):
+            os.remove(netlog)       # this run's ledger only
+        door = net.FrontDoor(router_net, netlog_path=netlog,
+                             registry=reg).start()
+        stream_n = 4
+        partials, ttfts = [], []
+        try:
+            for i in range(stream_n):
+                cli = net.FrontDoorClient(door.address)
+                try:
+                    r = cli.generate(prompts[i % len(prompts)],
+                                     cap_stream, tag=f"s{i}",
+                                     timeout_s=600.0)
+                finally:
+                    cli.close()
+                if r["tokens"] is None:
+                    raise RuntimeError(
+                        f"stream request {i} rejected: {r['reject']}")
+                if r["streamed"] != r["tokens"][:len(r["streamed"])]:
+                    raise RuntimeError(
+                        "streamed tokens diverge from the final result")
+                partials.append(r["partials"])
+                ttfts.append(r["ttft_s"])
+        finally:
+            door.close()            # terminal-logs anything live
+        stream_p99 = float(np.percentile(ttfts, 99))
+        netlog_summary = net.validate_netlog_file(
+            netlog, require_requests=stream_n)
+
+        # --- socket chaos: breaker cycle (SIGSTOP) + kill -9 ----------
+        fast_retry = RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                                 max_delay_s=0.2, deadline_s=2.0,
+                                 retry_on=(OSError, TimeoutError))
+        chaos_reps = {nm: net.NetReplica(
+            addrs[nm], name=nm, call_timeout_s=0.75, retry=fast_retry,
+            registry=reg) for nm in names}
+        fpol = fleet.FaultPolicy(max_consecutive_failures=8,
+                                 probe_timeout_s=120.0,
+                                 breaker_threshold=2,
+                                 breaker_cooldown_s=0.3, max_redrives=4)
+        router_x = fleet.FleetRouter(list(chaos_reps.values()),
+                                     registry=reg, tracer=tracer,
+                                     seed=17, faults=fpol)
+
+        def transitions_of(nm):
+            return [(old, new) for (n, old, new)
+                    in router_x.breaker_transitions if n == nm]
+
+        # phase 1: stop netC's process; router probes time out (a hung
+        # host IS a transport failure), breaker opens well under the
+        # death threshold; resume + cooldown + the deliberate half-open
+        # probe close it again — the full cycle over a real socket
+        os.kill(procs["netC"].pid, signal.SIGSTOP)
+        for _ in range(6):
+            router_x.step()
+            if ("closed", "open") in transitions_of("netC"):
+                break
+        else:
+            raise RuntimeError("chaos: netC breaker never opened")
+        os.kill(procs["netC"].pid, signal.SIGCONT)
+        time.sleep(fpol.breaker_cooldown_s + 0.05)
+        probe_frids = [router_x.submit(rng.integers(
+            1, config["vocab_size"], min(len_set)).astype(np.int32), 4)
+            for _ in range(3)]
+        router_x.run_until_idle(max_steps=1_000_000)
+        cycle = [("closed", "open"), ("open", "half_open"),
+                 ("half_open", "closed")]
+        it = iter(transitions_of("netC"))
+        breaker_cycle_ok = all(t in it for t in cycle)  # ordered subseq
+
+        # phase 2: kill -9 netB mid-burst — ejected on consecutive
+        # transport failures, requests redriven, outputs bit-identical
+        frids_x = [router_x.submit(p, cap) for p in prompts]
+        victim_live = [frid for frid, (rep, _l)
+                       in router_x._where.items()
+                       if rep is chaos_reps["netB"]]
+        for _ in range(200):        # let netB emit some tokens first
+            router_x.step()
+            if any(router_x.progress(f) for f in victim_live):
+                break
+        procs["netB"].kill()        # SIGKILL: the real dead socket
+        procs["netB"].wait()
+        steps = 0
+        while not router_x.idle():
+            router_x.step()
+            steps += 1
+            if steps > 1_000_000:
+                raise RuntimeError("chaos burst did not converge")
+        chaos_outs, chaos_shed, chaos_lost = [], 0, 0
+        for f in frids_x:
+            o = router_x.result(f)
+            chaos_outs.append(o)
+            if o is None:
+                if router_x.reject_reason(f) is not None:
+                    chaos_shed += 1
+                else:
+                    chaos_lost += 1
+        for f in probe_frids:       # no-silent-loss covers ALL
+            if router_x.result(f) is None \
+                    and router_x.reject_reason(f) is None:
+                chaos_lost += 1
+        chaos_parity = all(
+            o is not None and np.array_equal(r, o)
+            for r, o in zip(net_outs, chaos_outs))
+        bundles = router_x.postmortems()
+        for b in bundles:
+            obs.validate_postmortem_bundle(b)
+        pm_reasons = sorted({b["reason"] for b in bundles})
+        if "eject" not in pm_reasons:
+            raise RuntimeError("chaos: kill -9 shipped no eject "
+                               f"postmortem (saw {pm_reasons})")
+        chaos = {
+            "lost_requests": int(chaos_lost),
+            "redrive_parity": bool(chaos_parity),
+            "redrives": int(router_x.redrives_total),
+            "ejected": int(router_x.ejected_total),
+            "shed_structured": int(chaos_shed),
+            "breaker_cycle_ok": bool(breaker_cycle_ok),
+            "breaker_transitions": [
+                f"{nm}:{old}->{new}" for (nm, old, new)
+                in router_x.breaker_transitions],
+            "postmortems": len(bundles),
+            "postmortem_reasons": pm_reasons,
+            "postmortem_valid": True,       # validated above, or raised
+        }
+        for r in list(chaos_reps.values()) + reps_net:
+            r.close()
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    os.kill(proc.pid, signal.SIGCONT)  # if still stopped
+                except OSError:
+                    pass
+                proc.kill()
+                proc.wait()
+
+    result = {
+        "metric": "net_router_tokens_per_sec",
+        "value": round(net_tps, 2),
+        "unit": "tokens/s",
+        # 1.0 == transport costs nothing vs in-process; the gates that
+        # actually bind are parity / chaos / streaming, asserted below
+        "vs_baseline": round(net_tps / max(local_tps, 1e-9), 4),
+        "net_tokens_per_sec": round(net_tps, 2),
+        "local_tokens_per_sec": round(local_tps, 2),
+        "transport_overhead_ms_per_token": round(overhead_ms, 4),
+        "transport_parity_ok": bool(parity_ok),
+        "wire_codec": net.default_codec(),
+        "rpc_calls_total": int(rpc_calls),
+        "stream_requests": stream_n,
+        "stream_partials_min": int(min(partials)),
+        "stream_ttft_p99_s": round(stream_p99, 6),
+        "ttft_budget_s": ttft_budget,
+        "ttft_slo_met": bool(stream_p99 <= ttft_budget),
+        "netlog": os.path.basename(netlog),
+        "netlog_valid": netlog_summary,
+        "steady_state_recompiles": int(steady_recompiles),
+        "chaos": chaos,
+        "num_requests": n_req,
+        "replica_slots": slots,
+        "decode_cap": cap,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "dryrun": bool(dryrun),
+        "_telemetry": {"steps": leg_tel["steps"], "dt": leg_tel["dt"],
+                       "examples_per_step": slots,
+                       "tokens_per_step": total_tokens
+                       / max(leg_tel["steps"], 1)},
+    }
+    missing = [k for k in NET_SCHEMA if k not in result]
+    if missing:
+        raise RuntimeError(f"BENCH_NET schema self-check failed: "
+                           f"missing {missing}")
+    missing_chaos = [k for k in NET_CHAOS_SCHEMA if k not in chaos]
+    if missing_chaos:
+        raise RuntimeError(f"BENCH_NET chaos section self-check "
+                           f"failed: missing {missing_chaos}")
+    if not parity_ok:
+        raise RuntimeError("transport parity broken: the net fleet's "
+                           "greedy outputs differ from in-process")
+    if steady_recompiles != 0:
+        raise RuntimeError(
+            f"replica processes recompiled {steady_recompiles}x in "
+            "steady state — server-side warmup is not covering the "
+            "serving shapes")
+    if min(partials) < 2:
+        raise RuntimeError(
+            f"streaming leg delivered min {min(partials)} partial "
+            "frames — the front door is buffering, not streaming")
+    if chaos["lost_requests"] != 0:
+        raise RuntimeError(
+            f"socket chaos lost {chaos['lost_requests']} requests "
+            "silently — the no-silent-loss contract broke")
+    if not chaos["redrive_parity"]:
+        raise RuntimeError("socket-chaos redrive parity broken: "
+                           "redriven outputs differ")
+    if chaos["ejected"] < 1 or chaos["redrives"] < 1:
+        raise RuntimeError("socket chaos ejected/redrove nothing — "
+                           "the kill -9 injection is dead")
+    if not chaos["breaker_cycle_ok"]:
+        raise RuntimeError(
+            f"breaker never completed open->half_open->closed over "
+            f"the socket (saw {chaos['breaker_transitions']})")
+    committed = {k: v for k, v in result.items() if k != "_telemetry"}
+    with open(jpath, "w") as f:
+        json.dump(committed, f, indent=2)
+    result["bench_json"] = jpath
+    return result
+
+
 SERVING_SCHEMA = ("metric", "value", "unit", "vs_baseline",
                   "decode_tokens_per_sec", "baseline_tokens_per_sec",
                   "speedup_vs_dense_loop", "end_to_end_tokens_per_sec",
@@ -2338,6 +2714,8 @@ _BENCHES = {
                 "x vs default blocks"),
     "serving_tp": (run_bench_serving_tp, "serving_tp_decode_scaling_2x",
                    "x vs tp=1 (busy-time accounting)"),
+    "net_router": (run_bench_net_router, "net_router_tokens_per_sec",
+                   "tokens/s"),
 }
 
 
@@ -2356,7 +2734,7 @@ def main():
         obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
         if which in ("serving", "embedding_serving", "router", "kernels",
-                     "serving_tp"):
+                     "serving_tp", "net_router"):
             # CI smoke: tiny sizes + schema self-check
             result = _BENCHES[which][0](dev,
                                         dryrun="--dryrun" in sys.argv)

@@ -276,6 +276,39 @@ def make_serving_fleet(model, params, *, num_replicas: int = 2,
                               shed_spike_threshold=shed_spike_threshold)
 
 
+def make_net_serving_fleet(addresses, *, policy: str = "affinity",
+                           registry=None, tracer=None, seed: int = 0,
+                           faults=None, postmortem_dir=None,
+                           call_timeout_s: float = 60.0,
+                           shed_spike_threshold: int = 4):
+    """Process-isolated serving front end — the network sibling of
+    :func:`make_serving_fleet`. Each address in ``addresses`` points at
+    a replica server process (spawn them with
+    ``python -m paddle_tpu.serving.fleet.net.replica_server`` or
+    :func:`paddle_tpu.serving.fleet.net.spawn_replica_server`); this
+    connects a :class:`~paddle_tpu.serving.fleet.net.NetReplica` to
+    each and fronts them with the same
+    :class:`~paddle_tpu.serving.fleet.FleetRouter` the in-process fleet
+    uses — identical routing, breakers, exactly-once redrive and
+    migration, because the router cannot tell a socket from a thread
+    (the ReplicaHandle contract). A dead process shows up as transport
+    errors that trip its breaker and eject it; wrap the router in a
+    :class:`~paddle_tpu.serving.fleet.net.FrontDoor` to stream tokens
+    to clients. Returns the router."""
+    from paddle_tpu import observability as _obs
+    from paddle_tpu.serving import fleet as _fleet
+    from paddle_tpu.serving.fleet import net as _net
+    registry = registry or _obs.default()
+    tracer = tracer or _obs.tracing.default()
+    reps = [_net.NetReplica(addr, call_timeout_s=call_timeout_s,
+                            registry=registry)
+            for addr in addresses]
+    return _fleet.FleetRouter(reps, policy=policy, registry=registry,
+                              tracer=tracer, seed=seed, faults=faults,
+                              postmortem_dir=postmortem_dir,
+                              shed_spike_threshold=shed_spike_threshold)
+
+
 def make_embedding_serving_engine(store, model=None, params=None,
                                   **kwargs):
     """Online embedding-lookup serving front end — the sparse/recsys

@@ -68,6 +68,7 @@ class ExpositionServer:
         self._want_port = int(port)
         self._health: Dict[str, Callable[[], dict]] = {}
         self._postmortem: Dict[str, Callable[[], list]] = {}
+        self._json: Dict[str, Callable[[], object]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.monotonic()
@@ -87,6 +88,20 @@ class ExpositionServer:
         ``FleetRouter.postmortems`` or ``FlightRecorder.bundles``);
         served under ``/debug/postmortem``."""
         self._postmortem[name] = provider
+        return self
+
+    def add_json(self, path: str,
+                 provider: Callable[[], object]) -> "ExpositionServer":
+        """Register an extra read-only JSON route (a zero-arg callable
+        returning a JSON-able value) — how subsystems the exposition
+        server does not know about (e.g. the network front door's
+        ``/debug/netlog`` ledger) hang their debug views off the one
+        operator endpoint. Reserved routes cannot be shadowed."""
+        route = "/" + path.strip("/")
+        if route in ("/metrics", "/healthz", "/traces",
+                     "/debug/postmortem", "/"):
+            raise ValueError(f"route {route!r} is reserved")
+        self._json[route] = provider
         return self
 
     # -- lifecycle --------------------------------------------------------
@@ -177,11 +192,23 @@ class ExpositionServer:
                 payload = self.postmortems(limit=limit, replica=replica)
                 self._reply(h, 200, "application/json",
                             json.dumps(payload, default=str).encode())
+            elif route in self._json:
+                # the healthz discipline: a sick provider is a 503
+                # with the error in the body, never a dead endpoint
+                try:
+                    payload, code = self._json[route](), 200
+                except Exception as e:
+                    payload = {"error": f"{type(e).__name__}: {e}"}
+                    code = 503
+                self._reply(h, code, "application/json",
+                            json.dumps(payload, default=str).encode())
             else:
+                routes = " ".join(
+                    ["/metrics", "/healthz", "/traces",
+                     "/debug/postmortem"] + sorted(self._json))
                 self._reply(h, 404, "text/plain",
-                            b"paddle_tpu exposition: "
-                            b"/metrics /healthz /traces "
-                            b"/debug/postmortem\n")
+                            f"paddle_tpu exposition: {routes}\n"
+                            .encode())
         except BrokenPipeError:
             pass                     # scraper went away mid-reply
         except Exception as e:       # never take the endpoint down

@@ -35,9 +35,16 @@ ServingEngine` replicas as cattle (ROADMAP open item 2). Three parts:
    replicas (the autoscaler spawns replacements for the lost
    capacity), and :class:`ChaosReplica` injects all of it
    deterministically for the chaos test battery.
+5. **Network serving** (`net/`, ISSUE 17): the PR 9 promise cashed in —
+   ``net.ReplicaServer`` runs one engine per process behind a framed
+   wire protocol, ``net.NetReplica`` is the client-side
+   :class:`ReplicaHandle` the router drives with zero code forks, and
+   ``net.FrontDoor`` streams tokens to clients incrementally with
+   bounded buffers and structured rejects.
 """
 
-from paddle_tpu.serving.fleet.replica import LocalReplica, ReplicaHandle
+from paddle_tpu.serving.fleet.replica import (FullReplay, LocalReplica,
+                                              ReplicaHandle)
 from paddle_tpu.serving.fleet.router import FleetMonitor, FleetRouter
 from paddle_tpu.serving.fleet.autoscaler import FleetAutoscaler
 from paddle_tpu.serving.fleet.faults import (ChaosReplica, ChaosSpec,
@@ -50,7 +57,8 @@ from paddle_tpu.serving.engine import SlotMigrationError
 from paddle_tpu.serving.paged_cache import prompt_prefix_digests
 
 __all__ = [
-    "ReplicaHandle", "LocalReplica", "FleetRouter", "FleetMonitor",
+    "ReplicaHandle", "LocalReplica", "FullReplay",
+    "FleetRouter", "FleetMonitor",
     "FleetAutoscaler", "SlotMigrationError", "prompt_prefix_digests",
     "ChaosReplica", "ChaosSpec", "CircuitBreaker", "FailureDetector",
     "FaultPolicy", "ReplicaCrashed", "ReplicaUnavailable",

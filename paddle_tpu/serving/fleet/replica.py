@@ -30,6 +30,23 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 
+class FullReplay(list):
+    """A progress stream re-sent from offset 0.
+
+    ``progress(since=)`` normally returns only the tokens past the
+    caller's cursor. When the cursor is stale — negative, or past the
+    end of what the replica actually holds (a restore rewound the
+    stream, or the caller's bookkeeping desynced) — raising would turn
+    one confused poll into a dead replica, and silently returning an
+    empty (or negative-index!) slice would corrupt the router's replay
+    record. Instead the replica answers with the FULL stream wrapped in
+    this marker; a consumer REPLACES its record rather than extending
+    it. The marker survives the wire protocol (``net.wire`` encodes it
+    explicitly) so the semantics hold across a socket."""
+
+    full_replay = True
+
+
 class ReplicaHandle:
     """Transport interface between router and replica. Every method is
     host-side and cheap except ``step()`` (one engine iteration).
@@ -81,9 +98,12 @@ class ReplicaHandle:
         resubmits ``prompt + observed`` to a peer. ``since`` maps rid →
         token count the caller already holds; only the tokens past
         that index come back (the poll then costs O(new tokens) per
-        step instead of re-copying whole streams). Transports without
-        progress export return ``{}`` (redrive then re-decodes from
-        the prompt; greedy determinism keeps outputs identical)."""
+        step instead of re-copying whole streams). A stale or
+        out-of-range ``since`` cursor gets the full stream back as a
+        :class:`FullReplay` (replace, don't extend) instead of an
+        exception or a bogus slice. Transports without progress export
+        return ``{}`` (redrive then re-decodes from the prompt; greedy
+        determinism keeps outputs identical)."""
         return {}
 
     def poll_checkpoints(self) -> List[Tuple[int, Dict]]:
@@ -141,7 +161,8 @@ class LocalReplica(ReplicaHandle):
     ``health()`` stays safe because the engine publishes snapshots.
     """
 
-    def __init__(self, engine, name: str = "replica0"):
+    def __init__(self, engine, name: str = "replica0",
+                 clock=time.monotonic):
         self.engine = engine
         self.name = name
         # the black box carries the replica's fleet name so a fleet-wide
@@ -157,10 +178,15 @@ class LocalReplica(ReplicaHandle):
         # involuntary-failure surface: the background loop records its
         # own death here (health()/running() expose it, the router's
         # detector acts on it), and every step beats the heartbeat the
-        # hang detector ages
+        # hang detector ages. The clock MUST be monotonic-shaped: the
+        # age is a delta, and a wall clock here would let an NTP step
+        # fabricate (or hide) a hang — load-bearing once the age
+        # crosses a socket, where the remote host's wall clock is not
+        # even the same clock.
         self.failed = False
         self.last_error: Optional[str] = None
-        self._last_beat = time.monotonic()
+        self._clock = clock
+        self._last_beat = clock()
         # serializes engine MUTATIONS (submit vs step vs migration)
         # for threaded mode — a router-thread submit must not mutate
         # the scheduler queue mid-iteration. health() stays lock-free:
@@ -176,17 +202,17 @@ class LocalReplica(ReplicaHandle):
             # answering a submit IS a heartbeat: a sync-mode replica
             # only beats when stepped, and the first probe after a
             # long warmup must not read the gap as a hang
-            self._last_beat = time.monotonic()
+            self._last_beat = self._clock()
             return self.engine.submit(prompt, max_new_tokens, eos_id,
                                       lane=lane,
                                       ttft_deadline_s=ttft_deadline_s,
                                       trace_id=trace_id)
 
     def step(self) -> Dict[int, np.ndarray]:
-        t0 = time.monotonic()
+        t0 = self._clock()
         with self._lock:
             out = self.engine.step()
-        now = time.monotonic()
+        now = self._clock()
         self.busy_s += now - t0
         self.steps += 1
         self._last_beat = now
@@ -194,7 +220,7 @@ class LocalReplica(ReplicaHandle):
 
     def health(self) -> Dict[str, object]:
         h = dict(self.engine.health())
-        h["heartbeat_age_s"] = time.monotonic() - self._last_beat
+        h["heartbeat_age_s"] = self._clock() - self._last_beat
         h["failed"] = self.failed
         if self.last_error is not None:
             h["last_error"] = self.last_error
@@ -222,7 +248,7 @@ class LocalReplica(ReplicaHandle):
 
     def warmup(self):
         self.engine.warmup()
-        self._last_beat = time.monotonic()
+        self._last_beat = self._clock()
         return self
 
     def postmortem(self, reason: str, trace_ids=()) -> Optional[Dict]:
@@ -243,9 +269,16 @@ class LocalReplica(ReplicaHandle):
                 st = eng.scheduler.slots[i]
                 rid = st.request.rid
                 lo = since.get(rid, 0) if since else 0
-                # tail-only slice: O(new tokens) per poll, not O(all)
-                out[rid] = list(st.generated[lo:]) if lo \
-                    else list(st.generated)
+                if lo < 0 or lo > len(st.generated):
+                    # stale cursor (restore rewound the stream, or the
+                    # caller desynced): a raw slice would be empty or
+                    # negative-indexed garbage — answer with the full
+                    # stream, marked so the caller REPLACES its record
+                    out[rid] = FullReplay(st.generated)
+                else:
+                    # tail-only slice: O(new tokens) per poll, not O(all)
+                    out[rid] = list(st.generated[lo:]) if lo \
+                        else list(st.generated)
             return out
 
     def poll_checkpoints(self) -> List[Tuple[int, Dict]]:
@@ -303,7 +336,7 @@ class LocalReplica(ReplicaHandle):
             while not self._stop.is_set():
                 try:
                     if self.engine.scheduler.idle():
-                        self._last_beat = time.monotonic()
+                        self._last_beat = self._clock()
                         time.sleep(idle_sleep_s)
                         continue
                     self.step()

@@ -440,7 +440,16 @@ class FleetRouter:
             return                  # dying replica: keep last knowns
         for lrid, tail in prog.items():
             rec = recs.get(lrid)
-            if rec is not None:
+            if rec is None:
+                continue
+            if getattr(tail, "full_replay", False):
+                # the replica answered a stale cursor with the whole
+                # stream (progress contract hardening): REPLACE the
+                # live portion of the record — extending would
+                # double-count every token already held
+                rec.observed = list(rec.committed) + [int(t)
+                                                      for t in tail]
+            else:
                 rec.observed.extend(int(t) for t in tail)
         for lrid, snap in cps:
             rec = recs.get(lrid)
@@ -607,6 +616,15 @@ class FleetRouter:
 
     def request_stats(self, frid: int) -> Optional[Dict]:
         return self._stats.pop(frid, None)
+
+    def progress(self, frid: int) -> Optional[List[int]]:
+        """Tokens observed so far for an in-flight request (committed
+        redrive prefix + the live replica's progress polls) — the
+        incremental-token feed the streaming front door delivers from.
+        None once the request has finished, shed, or was never
+        accepted; non-destructive, unlike ``result``."""
+        rec = self._reqs.get(frid)
+        return None if rec is None else list(rec.observed)
 
     def trace_id(self, frid: int) -> int:
         return self._trace.get(frid, 0)

@@ -7,6 +7,8 @@ Usage:
     python tools/check_metrics_log.py --anatomy ANATOMY.jsonl \
         [--require-steps N]
     python tools/check_metrics_log.py --postmortem BUNDLE.json
+    python tools/check_metrics_log.py --netlog NETLOG.jsonl \
+        [--require-requests N]
 
 Exit 0 when every record validates (and at least N step/span records
 exist); exit 1 with a precise message otherwise. The bench scripts run
@@ -14,8 +16,11 @@ this over their own logs so malformed telemetry fails fast instead of
 polluting the BENCH_* trajectory; CI can point it at any training run
 log, trace export (``Tracer.export_jsonl``), step-anatomy export
 (``StepAnatomy.export_jsonl`` — schema + monotonic step ids + phase
-sums bounded by wall time), or flight-recorder postmortem bundle
-(``observability.flight.write_bundle``).
+sums bounded by wall time), flight-recorder postmortem bundle
+(``observability.flight.write_bundle``), or front-door netlog
+(``serving.fleet.net.FrontDoor`` — schema + monotonic frame ids +
+every accepted request terminated by exactly one of
+finished/shed/redriven).
 """
 
 from __future__ import annotations
@@ -100,11 +105,20 @@ def main(argv=None) -> int:
     ap.add_argument("--postmortem", action="store_true",
                     help="validate as a flight-recorder postmortem "
                          "bundle (single JSON file)")
+    ap.add_argument("--netlog", action="store_true",
+                    help="validate as a front-door connection/request "
+                         "netlog (serving.fleet.net schema; "
+                         "--require-requests gates accepted count)")
+    ap.add_argument("--require-requests", type=int, default=0,
+                    help="with --netlog: fail unless at least N "
+                         "requests were accepted")
     args = ap.parse_args(argv)
     # a mismatched flag/mode combination must fail fast, not silently
     # validate with no minimum-count gate
-    if sum((args.trace, args.anatomy, args.postmortem)) > 1:
-        ap.error("--trace / --anatomy / --postmortem are exclusive")
+    if sum((args.trace, args.anatomy, args.postmortem,
+            args.netlog)) > 1:
+        ap.error("--trace / --anatomy / --postmortem / --netlog are "
+                 "exclusive")
     if args.trace and args.require_steps:
         ap.error("--require-steps applies to run logs; "
                  "use --require-spans with --trace")
@@ -113,6 +127,11 @@ def main(argv=None) -> int:
     if args.postmortem and args.require_steps:
         ap.error("--require-steps does not apply to --postmortem "
                  "(a bundle is one record)")
+    if args.netlog and args.require_steps:
+        ap.error("--require-steps does not apply to --netlog; "
+                 "use --require-requests")
+    if args.require_requests and not args.netlog:
+        ap.error("--require-requests only applies with --netlog")
 
     try:
         if args.trace:
@@ -129,6 +148,11 @@ def main(argv=None) -> int:
             from paddle_tpu.observability import flight
             flight.validate_postmortem_file(args.path)
             n, what = 1, "postmortem bundle"
+        elif args.netlog:
+            from paddle_tpu.serving.fleet.net import frontdoor
+            summary = frontdoor.validate_netlog_file(
+                args.path, require_requests=args.require_requests)
+            n, what = summary["accepted_requests"], "accepted request"
         else:
             from paddle_tpu.observability import runlog
             n = runlog.validate_run_log(args.path,

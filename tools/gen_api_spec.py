@@ -41,6 +41,7 @@ def _is_local(obj, name, mod_name):
 
 def iter_api():
     import paddle_tpu as pt
+    import paddle_tpu.serving.fleet.net  # noqa: F401  (attribute access)
     from paddle_tpu import slim as _slim
 
     modules = {
@@ -62,6 +63,7 @@ def iter_api():
         "paddle_tpu.resilience": pt.resilience,
         "paddle_tpu.serving": pt.serving,
         "paddle_tpu.serving.fleet": pt.serving.fleet,
+        "paddle_tpu.serving.fleet.net": pt.serving.fleet.net,
         "paddle_tpu.embedding_serving": pt.embedding_serving,
         "paddle_tpu.profiler": pt.profiler,
         "paddle_tpu.debug": pt.debug,

@@ -229,6 +229,56 @@ print("serving_tp dryrun OK (scaling_2x=%s, scaling_4x=%s, "
          r["tp"]["2"]["collective_exposed_s"]))
 '
 
+# net_router bench smoke (ISSUE 17): the fleet split across REAL
+# subprocesses behind the wire-protocol ReplicaHandle must run
+# end-to-end on CPU — greedy outputs bit-identical to the in-process
+# LocalReplica fleet (the interface contract survives the socket), the
+# streaming front door delivers >=2 partial frames per request with a
+# validating crash-safe netlog, and the socket-chaos leg (SIGSTOP
+# breaker cycle + kill -9 eject/redrive over a real dead socket) loses
+# 0 requests with bit-identical redriven outputs and client-side
+# postmortems, 0 steady-state recompiles per replica process
+echo "== bench smoke (net_router + socket chaos dryrun) =="
+NET_OUT="$(python bench.py --model net_router --dryrun)"
+if echo "$NET_OUT" | grep -q '"error"'; then
+  echo "net_router bench dryrun failed: $NET_OUT"
+  exit 1
+fi
+echo "$NET_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("net_tokens_per_sec", "local_tokens_per_sec",
+          "transport_overhead_ms_per_token", "transport_parity_ok",
+          "wire_codec", "stream_partials_min", "stream_ttft_p99_s",
+          "ttft_slo_met", "netlog_valid", "steady_state_recompiles",
+          "chaos"):
+    assert k in r, f"BENCH_NET missing {k}"
+assert r["transport_parity_ok"] is True, \
+    "net fleet outputs diverged from in-process"
+assert r["steady_state_recompiles"] == 0, \
+    "replica subprocess recompiled in steady state"
+assert r["stream_partials_min"] >= 2, \
+    "front door buffered instead of streaming"
+assert r["ttft_slo_met"], "streamed TTFT blew the budget"
+assert r["netlog_valid"]["accepted_requests"] >= 4
+c = r["chaos"]
+assert c["lost_requests"] == 0, "socket chaos lost requests"
+assert c["redrive_parity"] is True
+assert c["ejected"] >= 1 and c["redrives"] >= 1
+assert c["breaker_cycle_ok"] is True, c["breaker_transitions"]
+assert c["postmortems"] >= 1
+assert "eject" in c["postmortem_reasons"], c["postmortem_reasons"]
+assert c["postmortem_valid"] is True
+print("net_router + socket chaos dryrun OK (overhead=%.3fms/token, "
+      "codec=%s)" % (r["transport_overhead_ms_per_token"],
+                     r["wire_codec"]))
+'
+# the front door netlog must validate standalone through the CLI (the
+# crash-safe ledger CI replays: schema + monotonic frame ids + every
+# accepted request terminated exactly once)
+python tools/check_metrics_log.py --netlog /tmp/BENCH_NET.netlog.jsonl \
+  --require-requests 4
+
 # kernel-layer bench smoke: the shared autotuner must measure all three
 # single-device Pallas kernels (flash, ragged decode, ragged prefill)
 # across 3 shape buckets through ONE dispatch harness, hit its cache on
