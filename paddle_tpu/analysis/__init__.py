@@ -8,7 +8,7 @@ buffers doubling peak HBM, reused PRNG keys, replicated multi-GB params
 runs. This package is the ahead-of-time complement to the observability
 subsystem's runtime ``RecompileDetector``:
 
-The analysis runs in three tiers, one per program representation:
+The analysis runs in four tiers, one per program representation:
 
 - :mod:`~paddle_tpu.analysis.ast_lint` — reads step-function *source*
   for host-sync idioms (``.item()``, ``np.asarray``, ``time.time()``,
@@ -24,6 +24,15 @@ The analysis runs in three tiers, one per program representation:
   rules — unexpected collectives, resharding churn, peak-HBM budgets,
   and the bucket-coverage proof that serving ``warmup()`` precompiles
   every reachable pow2 signature.
+- :mod:`~paddle_tpu.analysis.concurrency` — the *host threads* around
+  the jitted steps: the :func:`guarded_by` lock-discipline lint, the
+  static lock-order graph committed as ``tools/lock_order.json``
+  (cycles = potential deadlocks, drift-gated like cost budgets), and
+  the :func:`sanitize` runtime lock sanitizer that proves
+  ``observed ⊆ static`` during threaded tests. Its sibling
+  :mod:`~paddle_tpu.analysis.conformance` gates ReplicaHandle /
+  wire-dispatch interface drift and the single-source
+  ``Reject.reason`` vocabulary.
 - :mod:`~paddle_tpu.analysis.findings` — the reporting spine: structured
   :class:`Finding` records, text/JSON rendering, registry counting, and
   committed :class:`Suppressions` for CI (with stale-entry detection).
@@ -40,6 +49,15 @@ needs no hardware).
 from paddle_tpu.analysis.api import (LINT_MODES, LintError, abstractify,
                                      enforce, lint_fn, lint_train_step)
 from paddle_tpu.analysis.ast_lint import lint_callable, lint_source
+from paddle_tpu.analysis.concurrency import (DoubleAcquireError, LockGraph,
+                                             LockMonitor,
+                                             extract_lock_graph,
+                                             guarded_by, lint_concurrency,
+                                             lint_locks, load_lock_order,
+                                             lock_order_diff,
+                                             lock_order_manifest, sanitize)
+from paddle_tpu.analysis.conformance import (lint_interfaces,
+                                             lint_reject_vocab)
 from paddle_tpu.analysis.cost_model import (CostReport, analyze_module,
                                             estimate_cost,
                                             estimate_lowered)
@@ -52,10 +70,14 @@ from paddle_tpu.analysis.hlo_lint import (check_bucket_coverage,
 from paddle_tpu.analysis.jaxpr_lint import analyze_jaxpr
 
 __all__ = [
-    "CostReport", "LINT_MODES", "LintError", "RULES", "SEVERITIES",
-    "Finding", "Report", "Suppressions", "abstractify", "analyze_jaxpr",
+    "CostReport", "DoubleAcquireError", "LINT_MODES", "LintError",
+    "LockGraph", "LockMonitor", "RULES", "SEVERITIES", "Finding",
+    "Report", "Suppressions", "abstractify", "analyze_jaxpr",
     "analyze_module", "check_bucket_coverage", "embedding_bucket_coverage",
-    "enforce", "estimate_cost", "estimate_lowered", "lint_callable",
-    "lint_cost_report", "lint_fn", "lint_source", "lint_train_step",
+    "enforce", "estimate_cost", "estimate_lowered", "extract_lock_graph",
+    "guarded_by", "lint_callable", "lint_concurrency", "lint_cost_report",
+    "lint_fn", "lint_interfaces", "lint_locks", "lint_reject_vocab",
+    "lint_source", "lint_train_step", "load_lock_order",
+    "lock_order_diff", "lock_order_manifest", "sanitize",
     "serving_bucket_coverage",
 ]

@@ -93,6 +93,41 @@ RULES = {
         "registered kernel (and is not allowlisted in tools/"
         "kernel_registry_allowlist.txt): bespoke kernels bypass the "
         "shared autotuner, fallback harness, and parity battery"),
+    "unguarded-access": (
+        "error",
+        "a @guarded_by field is read or written outside a `with "
+        "self.<lock>:` scope (one level of intra-class call "
+        "propagation): a data race once a second thread exists"),
+    "lock-order-cycle": (
+        "error",
+        "the static lock-acquisition graph has a cycle: two threads "
+        "taking the locks in opposite order deadlock"),
+    "double-acquire": (
+        "error",
+        "a non-reentrant threading.Lock is acquired on a path that "
+        "already holds it: guaranteed same-thread deadlock"),
+    "lock-order-drift": (
+        "error",
+        "the extracted lock universe / acquisition edges differ from "
+        "the committed tools/lock_order.json (missing, orphaned, or "
+        "stale entries): regenerate with --update-lock-order and "
+        "review the order"),
+    "sanitizer-violation": (
+        "error",
+        "the runtime lock sanitizer observed an acquisition order "
+        "between statically-ordered locks that the committed graph "
+        "does not bless: an inversion or a statically invisible path"),
+    "interface-drift": (
+        "error",
+        "a ReplicaHandle implementation or the wire dispatch table "
+        "drifted from the handle protocol (missing method, signature "
+        "mismatch, or unmapped wire op): a new handle method missing "
+        "from the dispatch is a CI failure, not a runtime RemoteError"),
+    "reject-vocab-drift": (
+        "error",
+        "a Reject(...) construction uses a reason literal outside "
+        "scheduler.REJECT_REASONS, or a registry entry is constructed "
+        "nowhere: the vocabulary has a single source of truth"),
 }
 
 
@@ -105,7 +140,7 @@ class Finding:
     message: str              # specific to this site
     location: str = ""        # "eqn[3/0] pure_callback" or "file.py:42"
     fix: str = ""             # actionable hint
-    engine: str = "jaxpr"     # jaxpr | ast | plan
+    engine: str = "jaxpr"     # jaxpr | ast | plan | concurrency
 
     def __post_init__(self):
         if self.severity not in SEVERITIES:
