@@ -38,7 +38,11 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.analysis.concurrency import guarded_by
 
+
+@guarded_by("_cv", "_pending", "_oldest_pending_ts", "_error")
+@guarded_by("_vlock", "_versions", "_dirty")
 class StreamingUpdateChannel:
     """Bounded async push channel between a trainer and a serving
     engine's backing store."""
@@ -160,11 +164,14 @@ class StreamingUpdateChannel:
                 except queue.Empty:
                     break
             count = len(items)
+            err = None
             try:
                 self._apply(items)
             except Exception as e:
-                self._error = e
+                err = e
             with self._cv:
+                if err is not None:
+                    self._error = err
                 self._pending -= count
                 if self._pending == 0:
                     self._oldest_pending_ts = None
@@ -219,8 +226,11 @@ class StreamingUpdateChannel:
     # -- lifecycle --------------------------------------------------------
 
     def _raise_if_failed(self):
-        if self._error is not None:
+        # read-and-clear is a two-step mutation: without the lock a
+        # worker error landing between the read and the clear is lost
+        with self._cv:
             err, self._error = self._error, None
+        if err is not None:
             raise RuntimeError("streaming update worker failed") from err
 
     def flush(self):

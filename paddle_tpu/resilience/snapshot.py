@@ -50,6 +50,7 @@ import numpy as np
 
 from paddle_tpu import fs as fs_lib
 from paddle_tpu import observability
+from paddle_tpu.analysis.concurrency import guarded_by
 from paddle_tpu.resilience.retry import RetryPolicy, retry_call
 
 MANIFEST = "manifest.json"
@@ -184,6 +185,7 @@ def _parse_step(name: str) -> Optional[int]:
         return None
 
 
+@guarded_by("_err_lock", "_error")
 class SnapshotEngine:
     """Sharded, async, atomically-committed checkpoints under ``directory``.
 
@@ -216,6 +218,11 @@ class SnapshotEngine:
         self.process_index = int(process_index)
         self.process_count = int(process_count)
         self.fs.mkdirs(self.directory)
+        # writer-thread failure handoff: the worker sets it, the next
+        # save()/wait() read-and-clears it — two threads, so the pair
+        # of operations goes through _err_lock (a bare read-then-clear
+        # can drop an error that lands between the two statements)
+        self._err_lock = threading.Lock()
         self._error: Optional[BaseException] = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._worker = threading.Thread(
@@ -277,7 +284,8 @@ class SnapshotEngine:
                     "resilience_snapshots_total",
                     "successfully committed snapshots").inc()
             except BaseException as e:  # surfaced on next save()/wait()
-                self._error = e
+                with self._err_lock:
+                    self._error = e
             finally:
                 self._queue.task_done()
 
@@ -579,8 +587,9 @@ class SnapshotEngine:
 
     # -- lifecycle ----------------------------------------------------------
     def _raise_pending(self):
-        if self._error is not None:
+        with self._err_lock:
             e, self._error = self._error, None
+        if e is not None:
             raise e
 
     def wait_until_finished(self):
