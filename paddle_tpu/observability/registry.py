@@ -19,6 +19,8 @@ import math
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from paddle_tpu.analysis.concurrency import guarded_by
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -39,6 +41,7 @@ def _fmt_labels(key: LabelKey) -> str:
             + "}")
 
 
+@guarded_by("_lock", "_series")
 class _Metric:
     """Shared series bookkeeping; subclasses define the per-series cell.
 
@@ -359,6 +362,7 @@ class Histogram(_Metric):
             return list(cell.counts), cell.count, cell.sum
 
 
+@guarded_by("_lock", "_metrics")
 class MetricsRegistry:
     """Name -> metric table; the process-wide instance is ``default()``."""
 

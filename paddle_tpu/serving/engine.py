@@ -123,6 +123,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.analysis.concurrency import guarded_by
 from paddle_tpu.serving import decode_attention as DA
 from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
                                             quantize_kv)
@@ -149,6 +150,7 @@ class SlotMigrationError(RuntimeError):
     the target engine."""
 
 
+@guarded_by("_health_lock", "_health_snap")
 class ServingEngine:
     """Continuous-batching front end over a ``models.gpt.GPT``.
 
@@ -787,7 +789,9 @@ class ServingEngine:
             # count queue-empty waiting as "host gap"
             self.anatomy.cancel_step()
         self._refresh_health()
-        self.flight.note(self._health_snap)
+        with self._health_lock:
+            snap = self._health_snap
+        self.flight.note(snap)
         return finished
 
     def _decode_round(self, dslots) -> int:

@@ -25,9 +25,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from paddle_tpu.analysis.concurrency import guarded_by
+
+if TYPE_CHECKING:       # annotation only — no runtime import cycle
+    from paddle_tpu.serving.engine import ServingEngine
 
 
 class FullReplay(list):
@@ -150,6 +155,7 @@ class ReplicaHandle:
         pass
 
 
+@guarded_by("_lock", "engine")
 class LocalReplica(ReplicaHandle):
     """In-process replica over one :class:`ServingEngine`.
 
@@ -159,9 +165,17 @@ class LocalReplica(ReplicaHandle):
     pending (idle-backoff otherwise); finished results accumulate in a
     bounded engine-side store exactly as in synchronous mode, and
     ``health()`` stays safe because the engine publishes snapshots.
+
+    ``engine`` is ``@guarded_by("_lock")``: in threaded mode the router
+    submits/polls from its thread while the loop steps, so every engine
+    access that can mutate or observe mutable engine state goes through
+    ``self._lock``. The deliberate lock-free exceptions — ``health()``
+    (engine-published snapshots), ``page_size()``/``can_accept()``
+    (immutable config), ``postmortem()`` (must testify after the loop
+    died) — are committed with rationale in the suppression file.
     """
 
-    def __init__(self, engine, name: str = "replica0",
+    def __init__(self, engine: "ServingEngine", name: str = "replica0",
                  clock=time.monotonic):
         self.engine = engine
         self.name = name
@@ -230,7 +244,10 @@ class LocalReplica(ReplicaHandle):
         return self.engine.cache.config.page_size
 
     def prefix_digests(self) -> frozenset:
-        return self.engine.cache.published_digests()
+        with self._lock:
+            # published_digests walks the cache's digest map, which
+            # step()'s page commits mutate — same race as result()
+            return self.engine.cache.published_digests()
 
     def can_accept(self, total_tokens: int) -> bool:
         return (not self.draining
@@ -238,16 +255,24 @@ class LocalReplica(ReplicaHandle):
                 <= self.engine.cache.config.max_pages_per_slot)
 
     def idle(self) -> bool:
-        return self.engine.scheduler.idle()
+        # reads the scheduler's queue/slot state, which a concurrent
+        # step() mutates — cheap enough to take the lock every poll
+        with self._lock:
+            return self.engine.scheduler.idle()
 
     def result(self, rid: int) -> Optional[np.ndarray]:
-        return self.engine.result(rid)
+        with self._lock:
+            # pop-on-read from the engine's bounded result store — a
+            # mutation, not a snapshot read, so it needs the lock
+            return self.engine.result(rid)
 
     def request_stats(self, rid: int) -> Optional[Dict[str, float]]:
-        return self.engine.request_stats(rid)
+        with self._lock:
+            return self.engine.request_stats(rid)
 
     def warmup(self):
-        self.engine.warmup()
+        with self._lock:
+            self.engine.warmup()
         self._last_beat = self._clock()
         return self
 
@@ -335,7 +360,9 @@ class LocalReplica(ReplicaHandle):
         def loop():
             while not self._stop.is_set():
                 try:
-                    if self.engine.scheduler.idle():
+                    # locked idle() — the peek races a router-thread
+                    # submit otherwise (mid-mutation queue iteration)
+                    if self.idle():
                         self._last_beat = self._clock()
                         time.sleep(idle_sleep_s)
                         continue
