@@ -52,6 +52,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.analysis.concurrency import guarded_by
 from paddle_tpu.serving.fleet.net import wire
 from paddle_tpu.serving.scheduler import LoadShedError, Reject
 
@@ -78,6 +79,7 @@ class _ClientConn:
         self.closing = False        # flush outbox, then close
 
 
+@guarded_by("_netlog_lock", "_netlog", "_frame")
 class FrontDoor:
     """Client-facing streaming server over one FleetRouter."""
 
@@ -100,6 +102,7 @@ class FrontDoor:
         self._conns: Dict[socket.socket, _ClientConn] = {}
         self._owner: Dict[int, _ClientConn] = {}   # frid -> conn
         self._conn_seq = 0
+        self._netlog_lock = threading.Lock()
         self._frame = 0
         self._netlog = None
         self.netlog_path = netlog_path
@@ -120,15 +123,20 @@ class FrontDoor:
     def _log(self, event: str, **fields):
         """One JSONL line, flushed at the write — a ``kill -9`` of this
         process tears at most the line being written, never a committed
-        one (the validator tolerates a torn FINAL line only)."""
-        if self._netlog is None:
-            return
-        rec = {"schema": NETLOG_SCHEMA, "frame": self._frame,
-               "ts": time.time(), "event": event}
-        rec.update(fields)
-        self._frame += 1
-        self._netlog.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._netlog.flush()
+        one (the validator tolerates a torn FINAL line only). The lock
+        makes a line and its frame id atomic across threads (pump loop
+        vs. a closing owner): interleaved writers would tear interior
+        lines and duplicate frame ids, both of which the validator
+        treats as corruption."""
+        with self._netlog_lock:
+            if self._netlog is None:
+                return
+            rec = {"schema": NETLOG_SCHEMA, "frame": self._frame,
+                   "ts": time.time(), "event": event}
+            rec.update(fields)
+            self._frame += 1
+            self._netlog.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._netlog.flush()
 
     # -- health / exposition ----------------------------------------------
     def health(self) -> Dict[str, object]:
@@ -195,9 +203,10 @@ class FrontDoor:
         self._lsock.close()
         self._sel.close()
         self._log("close")
-        if self._netlog is not None:
-            self._netlog.close()
-            self._netlog = None
+        with self._netlog_lock:
+            if self._netlog is not None:
+                self._netlog.close()
+                self._netlog = None
         self._closed = True
 
     # -- the pump ----------------------------------------------------------

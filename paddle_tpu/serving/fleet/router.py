@@ -53,12 +53,14 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import threading
 import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.analysis.concurrency import guarded_by
 from paddle_tpu.serving.engine import SlotMigrationError
 from paddle_tpu.serving.fleet.faults import (BREAKER_GAUGE, CircuitBreaker,
                                              FailureDetector, FaultPolicy,
@@ -95,6 +97,7 @@ class _FleetRequest:
     checkpoint: Optional[Dict] = None
 
 
+@guarded_by("_view_lock", "_postmortems")
 class FleetRouter:
     """Single front door over N :class:`ReplicaHandle` replicas.
 
@@ -156,6 +159,10 @@ class FleetRouter:
         # is configured, onto disk for the offline renderer
         self.postmortem_dir = postmortem_dir
         self.shed_spike_threshold = int(shed_spike_threshold)
+        # the bundle ring crosses threads: the pump appends in
+        # _dump_postmortem while the exposition HTTP thread reads it
+        # through postmortems()/health()
+        self._view_lock = threading.Lock()
         self._postmortems: "deque" = deque(maxlen=16)
         self._sheds_since_dump = 0
         self._postmortem_seq = 0
@@ -636,8 +643,12 @@ class FleetRouter:
         eject/redrive totals; ``degraded`` is set while any breaker is
         open or half-open, which the exposition endpoint surfaces as
         HTTP 503."""
+        # called from the exposition HTTP thread while the pump mutates
+        # the fleet: snapshot the replica list once so add/eject mid-
+        # iteration can't blow up the scrape
+        reps = list(self.replicas)
         per = {}
-        for r in self.replicas:
+        for r in reps:
             try:
                 per[r.name] = r.health()
             except NotImplementedError:
@@ -648,9 +659,11 @@ class FleetRouter:
                 per[r.name] = {"error": f"{type(e).__name__}: {e}"}
         occ = [float(h.get("slot_occupancy", 0.0)) for h in per.values()]
         breakers = {r.name: self._breakers[id(r)].status()
-                    for r in self.replicas if id(r) in self._breakers}
+                    for r in reps if id(r) in self._breakers}
+        with self._view_lock:
+            n_postmortems = len(self._postmortems)
         return {
-            "replicas": len(self.replicas),
+            "replicas": len(reps),
             # chips behind the fleet (ISSUE 15): a tp=4 replica is 4
             # chips of capacity — the autoscaler and /healthz must not
             # read it as one
@@ -668,7 +681,7 @@ class FleetRouter:
             "routable": self.routable_count(),
             "ejected_total": self.ejected_total,
             "redrives_total": self.redrives_total,
-            "postmortems": len(self._postmortems),
+            "postmortems": n_postmortems,
             "breakers": breakers,
             "degraded": any(b["state"] != CircuitBreaker.CLOSED
                             for b in breakers.values()),
@@ -758,7 +771,8 @@ class FleetRouter:
             return None
         if extra:
             bundle.setdefault("extra", {}).update(extra)
-        self._postmortems.append(bundle)
+        with self._view_lock:
+            self._postmortems.append(bundle)
         self._postmortem_seq += 1
         self._reg.counter(
             "fleet_postmortems_total",
@@ -783,8 +797,9 @@ class FleetRouter:
 
     def postmortems(self, limit: Optional[int] = None) -> List[Dict]:
         """Captured postmortem bundles, oldest first (bounded ring) —
-        the ``/debug/postmortem`` payload source."""
-        out = list(self._postmortems)
+        the ``/debug/postmortem`` payload source (HTTP thread)."""
+        with self._view_lock:
+            out = list(self._postmortems)
         return out[-limit:] if limit else out
 
     def _redrive(self, frid: int, *, src: str = "?"):
