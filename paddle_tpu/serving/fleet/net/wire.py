@@ -46,7 +46,8 @@ from paddle_tpu.serving.engine import SlotMigrationError
 from paddle_tpu.serving.fleet.faults import (ReplicaCrashed,
                                              ReplicaUnavailable)
 from paddle_tpu.serving.fleet.replica import FullReplay
-from paddle_tpu.serving.scheduler import LoadShedError, Reject
+from paddle_tpu.serving.scheduler import (LoadShedError, REJECT_REASONS,
+                                          Reject)
 
 try:                                # optional accelerator, never required
     import msgpack                  # type: ignore
@@ -274,7 +275,13 @@ def reject_to_wire(rej: Reject) -> Dict[str, Any]:
 
 
 def reject_from_wire(d: Dict[str, Any]) -> Reject:
-    return Reject(**d)
+    rej = Reject(**d)
+    if rej.reason not in REJECT_REASONS:
+        # an unknown reason means the peer speaks a newer (or corrupted)
+        # vocabulary — surface it as protocol drift, not a silent pass
+        raise WireError(f"unknown Reject reason {rej.reason!r} "
+                        f"(registered: {REJECT_REASONS})")
+    return rej
 
 
 # remote exception types this side re-raises as themselves; anything

@@ -67,14 +67,29 @@ class Request:
         return self.submitted_at + self.ttft_deadline_s
 
 
+#: the Reject.reason vocabulary — the ONE source of truth. The wire
+#: protocol validates decoded rejects against it, the parametrized wire
+#: tests enumerate it, and ``analysis.conformance.lint_reject_vocab``
+#: statically checks that every constructed literal is registered and
+#: every entry is constructed somewhere.
+REJECT_REASONS = (
+    "queue_full",            # submit: bounded queue at capacity
+    "deadline_infeasible",   # submit: est TTFT already past the deadline
+    "deadline_expired",      # queued past its TTFT deadline (engine reap
+                             # or router pre-redrive check)
+    "redrive_budget",        # router: per-request redrive budget spent
+    "no_replica",            # router: no live replica can accept it
+    "requeue_shed",          # router: drain-requeue landed nowhere
+    "slow_reader",           # front door: client stream backpressure
+)
+
+
 @dataclasses.dataclass
 class Reject:
     """Structured load-shed verdict (the body of :class:`LoadShedError`):
     everything a client needs to back off sensibly instead of the
-    request silently queueing forever."""
-    # "queue_full" | "deadline_infeasible" (both at submit) |
-    # "deadline_expired" (reaped from the queue by the engine's
-    # shed_expired pass, surfaced via engine.reject_reason)
+    request silently queueing forever. ``reason`` is one of
+    :data:`REJECT_REASONS`."""
     reason: str
     lane: str
     queue_depth: int

@@ -58,10 +58,10 @@ VOCAB = 64
 CODECS = ["json"] + (["msgpack"] if wire.msgpack is not None else [])
 
 # the full structured-shed vocabulary: engine submit/reap sheds, router
-# redrive/requeue sheds, and the front door's own slow-reader verdict
-REJECT_REASONS = ("queue_full", "deadline_infeasible", "deadline_expired",
-                  "redrive_budget", "no_replica", "requeue_shed",
-                  "slow_reader")
+# redrive/requeue sheds, and the front door's own slow-reader verdict —
+# read from the one registered source of truth so the parametrized wire
+# tests can never drift from what the protocol validates
+from paddle_tpu.serving.scheduler import REJECT_REASONS  # noqa: E402
 
 FAST_RETRY = RetryPolicy(max_attempts=2, base_delay_s=0.02,
                          max_delay_s=0.1, deadline_s=2.0,
