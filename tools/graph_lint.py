@@ -27,6 +27,14 @@ regression gate that needs no hardware. ``--update-budgets`` rewrites
 the manifest from the current measurements (commit it with the PR that
 legitimately moved the numbers).
 
+``--concurrency`` adds the host-thread tier (``analysis.concurrency`` +
+``analysis.conformance``): the ``@guarded_by`` lock-discipline pass over
+every package module, cycle/double-acquire detection on the extracted
+static lock-acquisition graph, the drift gate against the committed
+``tools/lock_order.json`` (regenerate with ``--update-lock-order``,
+mirroring ``--update-budgets``), ReplicaHandle/wire-dispatch interface
+conformance, and the single-source ``Reject.reason`` vocabulary check.
+
 Everything here is abstract tracing and lowering: no weights are
 trained, nothing is compiled or executed, so the whole preset runs in
 seconds on CPU.
@@ -67,9 +75,23 @@ DEFAULT_SUPPRESSIONS = os.path.join(os.path.dirname(__file__),
                                     "graph_lint_suppressions.txt")
 DEFAULT_BUDGETS = os.path.join(os.path.dirname(__file__),
                                "cost_budgets.json")
+DEFAULT_LOCK_ORDER = os.path.join(os.path.dirname(__file__),
+                                  "lock_order.json")
 
 #: metrics --cost-diff gates against the committed baseline
 DIFF_METRICS = ("flops", "peak_hbm_bytes", "collective_bytes")
+
+#: rules that only fire in their optional tier — the stale-suppression
+#: gate is scoped to rules whose tier actually RAN this invocation, so
+#: the plain `--preset framework` CI leg doesn't reject committed
+#: entries that only the `--concurrency` / `--cost` legs can match
+CONCURRENCY_RULES = frozenset({
+    "unguarded-access", "lock-order-cycle", "double-acquire",
+    "lock-order-drift", "sanitizer-violation", "interface-drift",
+    "reject-vocab-drift"})
+COST_RULES = frozenset({
+    "unexpected-collective", "resharding-churn", "peak-hbm-budget",
+    "bucket-coverage", "cost-regression"})
 
 
 def _train_step_report(model, loss_fn, sample_batch, *, name,
@@ -417,6 +439,21 @@ def lint_kernel_registry(suppressions, cost=False):
     return kernels.lint_registry(suppressions)
 
 
+def concurrency_report(suppressions, *, lock_order):
+    """The host-thread tier (``--concurrency``): lock discipline + the
+    lock-order graph + drift gate, plus the conformance lints (interface
+    drift, reject vocabulary) — one report on the shared spine."""
+    from paddle_tpu.analysis import conformance
+
+    report = analysis.lint_concurrency(lock_order=lock_order,
+                                       suppressions=suppressions,
+                                       registry=False)
+    report.extend(conformance.lint_interfaces())
+    report.extend(conformance.lint_reject_vocab())
+    report.count_into_registry()
+    return report
+
+
 PRESETS = {
     "framework": [lint_lenet, lint_resnet18, lint_gpt_decode,
                   lint_convgroup, lint_serving_decode,
@@ -501,6 +538,18 @@ def main(argv=None) -> int:
     ap.add_argument("--update-budgets", action="store_true",
                     help="rewrite the budget manifest from the current "
                          "measurements (commit it with the PR)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="add the host-thread tier: @guarded_by lock "
+                         "discipline, lock-order graph + drift gate vs "
+                         "tools/lock_order.json, interface conformance, "
+                         "Reject.reason vocabulary")
+    ap.add_argument("--lock-order", default=DEFAULT_LOCK_ORDER,
+                    help="committed lock-order manifest "
+                         "(tools/lock_order.json)")
+    ap.add_argument("--update-lock-order", action="store_true",
+                    help="rewrite the lock-order manifest from the "
+                         "extracted graph (refuses while the graph is "
+                         "cyclic; commit it with the PR)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -543,6 +592,33 @@ def main(argv=None) -> int:
         if not report.ok(args.fail_on):
             rc = 1
 
+    conc_mode = args.concurrency or args.update_lock_order
+    if conc_mode:
+        # when regenerating, skip the drift gate (it is the thing being
+        # rewritten) but keep cycle/double-acquire/discipline findings —
+        # a cyclic graph must never be blessed
+        report = concurrency_report(
+            sup, lock_order=None if args.update_lock_order
+            else args.lock_order)
+        print(report.render_json() if args.json else report.render_text())
+        if not report.ok(args.fail_on):
+            rc = 1
+        if args.update_lock_order:
+            from paddle_tpu.analysis import concurrency as _conc
+            if not report.graph.acyclic():
+                print("refusing to write a CYCLIC lock-order manifest — "
+                      "fix the cycle first (see findings above)",
+                      file=sys.stderr)
+                rc = 1
+            else:
+                manifest = _conc.lock_order_manifest(report.graph)
+                with open(args.lock_order, "w") as f:
+                    json.dump(manifest, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"wrote {args.lock_order} "
+                      f"({len(manifest['locks'])} locks, "
+                      f"{len(manifest['edges'])} edges)")
+
     if args.update_budgets:
         manifest = {
             "_comment": [
@@ -573,9 +649,16 @@ def main(argv=None) -> int:
         rc = max(rc, cost_diff(measured, budgets))
 
     # stale-suppression gate: only meaningful after the FULL preset has
-    # had the chance to match every committed entry
+    # had the chance to match every committed entry — and scoped to the
+    # tiers that actually ran (a concurrency-rule entry can only match
+    # under --concurrency; judging it stale without running that tier
+    # would make the plain CI leg reject legitimate committed entries)
     if sup is not None and args.preset == "framework":
         stale = sup.stale()
+        if not conc_mode:
+            stale = [e for e in stale if e[0] not in CONCURRENCY_RULES]
+        if not cost_mode:
+            stale = [e for e in stale if e[0] not in COST_RULES]
         if stale:
             for rule, pat in stale:
                 print(f"stale suppression: `{rule}  {pat}` matched no "

@@ -331,9 +331,15 @@ print("kernels dryrun OK (geomean %sx vs default blocks)" % r["value"])
 # build when any surface's static flops / peak-HBM / collective bytes
 # regress >10% vs tools/cost_budgets.json (a hardware-free perf gate;
 # regenerate the manifest with --update-budgets when a regression is
-# intentional and justify it in the PR)
+# intentional and justify it in the PR). The --concurrency tier adds the
+# host-thread rules: @guarded_by lock discipline over every package
+# module, cycle/double-acquire detection on the static lock-acquisition
+# graph plus the drift gate against the committed tools/lock_order.json
+# (regenerate with --update-lock-order and review the order),
+# ReplicaHandle/wire-dispatch interface conformance, and the
+# single-source Reject.reason vocabulary check
 echo "== graph self-lint + cost budgets (framework preset) =="
-python tools/graph_lint.py --preset framework --cost --cost-diff
+python tools/graph_lint.py --preset framework --cost --cost-diff --concurrency
 
 if [ "$MODE" = "--quick" ]; then
   echo "CI OK (quick tier)"
