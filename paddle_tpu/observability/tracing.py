@@ -420,6 +420,19 @@ def validate_trace_record(rec: Dict[str, Any], *, index: int = 0):
         if not isinstance(ev, dict) or not isinstance(ev.get("name"), str) \
                 or not isinstance(ev.get("ts"), (int, float)):
             fail(f"malformed event {ev!r}")
+    if rec["name"] == "router.handoff":
+        # disaggregation contract (ISSUE 19): a handoff span rides the
+        # REQUEST's trace id (one Perfetto timeline from route through
+        # handoff to decode) and names its source; a successfully
+        # placed handoff also names the decode destination
+        attrs = rec.get("attrs") or {}
+        if not attrs.get("src"):
+            fail("router.handoff span missing 'src' attr")
+        if rec["trace_id"] == 0:
+            fail("router.handoff span is off the request's trace "
+                 "(trace_id=0)")
+        if rec.get("status", "ok") == "ok" and not attrs.get("dst"):
+            fail("placed router.handoff span missing 'dst' attr")
 
 
 def validate_trace_log(path: str, *, require_spans: int = 0) -> int:

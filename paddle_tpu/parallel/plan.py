@@ -197,6 +197,27 @@ def serving_tp_plan() -> ShardingPlan:
     ])
 
 
+def serving_prefill_tp_plan() -> ShardingPlan:
+    """:func:`serving_tp_plan` plus the Megatron MLP split the PREFILL
+    tier wants (ISSUE 19): prefill is flops-bound, so the MLP matmuls
+    dominate and sharding them is worth a second collective per layer.
+    ``fc1`` (the SpecLayout ``ffn_up``) is column-sharded over "tp" on
+    its output dim, ``fc2`` (``down``) is row-sharded on its input dim,
+    and the fc2 bias stays replicated so it is added exactly once AFTER
+    the psum of the row-parallel partial products. Decode-tier and
+    colocated engines keep :func:`serving_tp_plan`'s replicated MLP and
+    its single-psum step shape."""
+    return ShardingPlan(rules=[
+        (r"attn/qkv_tp/weight$", P(None, None, "tp", None)),
+        (r"attn/qkv_tp/bias$", P(None, "tp", None)),
+        (r"attn/out_tp/weight$", P("tp", None, None)),
+        (r"mlp/fc1/weight$", P(None, "tp")),
+        (r"mlp/fc1/bias$", P("tp")),
+        (r"mlp/fc2/weight$", P("tp", None)),
+        (r"^", P()),      # everything else (incl. fc2 bias) replicated
+    ])
+
+
 def paged_pool_specs(pages) -> list:
     """PartitionSpec pytree for a :class:`~paddle_tpu.serving
     .PagedKVCache` page pool under tp: K/V page arrays sharded over

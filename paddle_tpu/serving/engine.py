@@ -181,12 +181,31 @@ class ServingEngine:
                  snapshot_every_blocks: Optional[int] = None,
                  mesh=None, tp: Optional[int] = None,
                  tp_probe: bool = False,
-                 anatomy_probe_every: Optional[int] = None):
+                 anatomy_probe_every: Optional[int] = None,
+                 tier: str = "colocated"):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
                 "ServingEngine needs the LayerList GPT layout; convert "
                 "stacked/pipeline checkpoints for serving first")
+        # -- disaggregation tier (ISSUE 19): a "prefill" engine runs
+        # only the batched chunked prefill step and PARKS prefill-done
+        # slots for handoff (poll_handoffs snapshots + releases them); a
+        # "decode" engine accepts only restored slots and runs only the
+        # decode block. "colocated" (default) is the classic engine —
+        # every existing shape, bucket, and test is untouched.
+        if tier not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"tier must be 'colocated', 'prefill' or 'decode', "
+                f"got {tier!r}")
+        if tier != "colocated" and draft_model is not None:
+            raise ValueError(
+                "speculative decoding does not compose with a "
+                "disaggregated tier (draft caches do not migrate)")
+        self.tier = tier
+        # handoff-fallback slots allowed to decode on a prefill-tier
+        # engine (restore_slot honors snap["decode_in_place"])
+        self._decode_in_place: set = set()
         self.model = model
         self.params = params
         self.attn_impl = attn_impl
@@ -237,6 +256,12 @@ class ServingEngine:
         self.tp_probe = bool(tp_probe)
         self.tp_spmd = self.mesh is not None and tp > 1
         self._tp_heads = cfg.num_heads // tp
+        # prefill tier + spmd tp: shard the MLP too (Megatron ffn_up
+        # column / down row split) — prefill is flops-bound, so the MLP
+        # matmuls are worth the second psum per layer. Gated to the
+        # prefill tier so the colocated/decode step HLO (and every
+        # pre-existing cost surface) stays byte-identical.
+        self._mlp_sharded = self.tier == "prefill" and self.tp_spmd
         # -- speculative decoding (ISSUE 13): a draft model proposes
         # spec_k tokens per slot per round; the target verifies them all
         # in ONE fixed-shape batched-prefill-shaped step
@@ -380,8 +405,10 @@ class ServingEngine:
                     # device_put consumes the tree
                     self._probe_params = self._tp_shard_slice(
                         tp_params, 0)
-                self._param_specs = plan_lib.serving_tp_plan() \
-                    .params_specs(tp_params)
+                tp_plan = (plan_lib.serving_prefill_tp_plan()
+                           if self._mlp_sharded
+                           else plan_lib.serving_tp_plan())
+                self._param_specs = tp_plan.params_specs(tp_params)
                 self._step_params = jax.device_put(
                     tp_params,
                     plan_lib.named_shardings(mesh, self._param_specs))
@@ -510,6 +537,13 @@ class ServingEngine:
         :class:`~paddle_tpu.serving.Reject`) when the scheduler sheds
         the request instead of queueing it."""
         from paddle_tpu.serving.scheduler import LoadShedError
+        if self.tier == "decode":
+            # fresh prompts would run prefill buckets this tier never
+            # warms; the two-tier router routes prompts to the prefill
+            # tier and this engine only ever sees restore_slot
+            raise ValueError(
+                "decode-tier engines accept only restored slots "
+                "(restore_slot), not fresh prompts")
         total = len(np.asarray(prompt).reshape(-1)) + max_new_tokens
         limit = min(self.cache.config.max_tokens_per_slot,
                     self.model.cfg.max_position)
@@ -611,6 +645,9 @@ class ServingEngine:
             "tp": self.tp,
             "mesh_devices": self.tp if self.tp_spmd else 1,
             "tp_probe": self.tp_probe,
+            # disaggregation tier: the two-tier router and the
+            # autoscaler key placement/scaling decisions off this
+            "tier": self.tier,
         }
         if self.slo_monitor is not None:
             h["slo"] = self.slo_monitor.status()
@@ -757,6 +794,11 @@ class ServingEngine:
                 break
 
         dslots = self.scheduler.decode_slots()
+        if self.tier == "prefill":
+            # prefill-done slots PARK for handoff (the replica handle
+            # drains them via poll_handoffs); only the handoff-fallback
+            # slots explicitly flagged decode-in-place decode here
+            dslots = [i for i in dslots if i in self._decode_in_place]
         if dslots:
             # occupancy/utilization of the batch the decode step
             # actually runs with (recorded before eviction, which
@@ -1001,6 +1043,7 @@ class ServingEngine:
         out = {}
         for slot, st in self.scheduler.evict_finished().items():
             self.cache.free_slot(slot)
+            self._decode_in_place.discard(slot)
             if self.speculative:
                 self.draft_cache.free_slot(slot)
             toks = np.asarray(st.generated, np.int32)
@@ -1026,6 +1069,12 @@ class ServingEngine:
                 "spec_proposed": acc.get("spec_proposed", 0.0),
                 "spec_accepted": acc.get("spec_accepted", 0.0),
                 "tokens": float(len(st.generated)),
+                # handoff timestamps (ISSUE 19): monotonic stamps that
+                # attribute the TTFT split's transfer time honestly —
+                # 0.0 on requests that never crossed a tier boundary
+                "prefill_done_s": acc.get("prefill_done_s", 0.0),
+                "handoff_s": acc.get("handoff_s", 0.0),
+                "decode_start_s": acc.get("decode_start_s", 0.0),
                 "trace_id": float(root.trace_id) if root is not None
                 else float(self._ext_trace.pop(req.rid, 0)),
             }
@@ -1212,6 +1261,8 @@ class ServingEngine:
                 if st.prefill_done:
                     st.generated.append(int(nxt[j]))
                     st.first_token_at = now
+                    if acc is not None:
+                        acc["prefill_done_s"] = now
                     ttft = now - st.request.submitted_at
                     self._reg.histogram(
                         "serving_ttft_seconds",
@@ -1300,7 +1351,20 @@ class ServingEngine:
         # covers every page a fleet drain ever reads or writes
         plan.append(("page_read",))
         plan.append(("page_write",))
-        return plan
+        return [sig for sig in plan if self._tier_sig(sig)]
+
+    def _tier_sig(self, sig) -> bool:
+        """Tier filter over bucket signatures (ISSUE 19): a prefill
+        replica warms only prefill + page-IO buckets, a decode replica
+        only decode + page-IO buckets — the per-tier half of the
+        bucket-coverage proof (plan == reachable per tier). Page IO and
+        the CoW copy stay on both tiers: handoff reads pages on the
+        prefill side and writes them on the decode side."""
+        if self.tier == "prefill" and sig[0] in ("decode", "decode_probe"):
+            return False
+        if self.tier == "decode" and sig[0] == "prefill":
+            return False
+        return True
 
     def reachable_signatures(self):
         """Every bucket signature the steady-state ``step()`` loop can
@@ -1328,7 +1392,7 @@ class ServingEngine:
         sigs.add(("copy_page",))
         sigs.add(("page_read",))
         sigs.add(("page_write",))
-        return sigs
+        return {sig for sig in sigs if self._tier_sig(sig)}
 
     def warmup(self, cost_gauges: bool = True):
         """Compile every decode AND prefill gather-width bucket plus the
@@ -1559,6 +1623,35 @@ class ServingEngine:
         out, self._micro_snaps = self._micro_snaps, {}
         return out
 
+    def poll_handoffs(self) -> List:
+        """Drain the prefill tier's handoff outbox (ISSUE 19): every
+        PARKED prefill-done slot — prompt fully prefilled, first token
+        emitted, not finished, not flagged decode-in-place — is
+        :meth:`snapshot_slot`-ted (the exact migration transfer format:
+        per-(page, tp-shard) sha256 shards) and released, freeing its
+        slot for the next prompt immediately. Returns ``[(rid,
+        snapshot), ...]``; the router streams each snapshot to a
+        decode-tier replica's :meth:`restore_slot`. Empty on
+        non-prefill tiers (and on an idle prefill tier)."""
+        if self.tier != "prefill":
+            return []
+        out = []
+        now = time.monotonic()
+        for slot in list(self.scheduler.active_slots()):
+            st = self.scheduler.slots[slot]
+            if not st.prefill_done or st.finished() \
+                    or slot in self._decode_in_place:
+                continue
+            rid = st.request.rid
+            snap = self.snapshot_slot(slot)
+            # the transfer-time half of the handoff timestamp split;
+            # restore_slot stamps decode_start_s on the receiving tier
+            snap["state"]["phase_acc"]["handoff_s"] = now
+            self.release_slot(slot)
+            out.append((rid, snap))
+        self._refresh_health()
+        return out
+
     def _shard_digest(self, shard) -> str:
         """sha256 of one migration shard — a quantized shard hashes the
         int8 KV AND its scale rows as one digest (a scale-only
@@ -1609,6 +1702,7 @@ class ServingEngine:
             raise SlotMigrationError(f"slot {slot} is empty")
         self.scheduler.slots[slot] = None
         self.cache.free_slot(slot)
+        self._decode_in_place.discard(slot)
         if self.speculative:
             self.draft_cache.free_slot(slot)
         rid = st.request.rid
@@ -1689,6 +1783,15 @@ class ServingEngine:
                 f"({tp_shards} per page) of a {total}-token "
                 "reservation — snapshot state inconsistent, refusing "
                 "to restore")
+        if self.tier == "decode" and \
+                int(snap["state"]["prefilled"]) < int(prompt.shape[0]):
+            # a mid-prefill slot would run prefill buckets this tier
+            # never warms; such snapshots restore on prefill/colocated
+            # peers (which finish the prefill and hand off again)
+            raise SlotMigrationError(
+                "decode-tier engines restore only prefill-complete "
+                f"slots ({int(snap['state']['prefilled'])} of "
+                f"{int(prompt.shape[0])} prompt tokens prefilled)")
         if not self.cache.can_reserve(total):
             raise SlotMigrationError(
                 f"no page capacity for {total} tokens")
@@ -1726,9 +1829,18 @@ class ServingEngine:
                        admitted_at=stt["admitted_at"],
                        first_token_at=stt["first_token_at"])
         self.scheduler.slots[slot] = st
+        if snap.get("decode_in_place") and self.tier == "prefill":
+            # handoff fallback (ISSUE 19): no decode-tier capacity, so
+            # this prefill engine decodes the slot itself — the one
+            # documented exception to the prefill tier's decode gate
+            # (and to its zero-recompile steady state)
+            self._decode_in_place.add(slot)
         acc = {"prefill_s": 0.0, "decode_s": 0.0, "prefill_chunks": 0.0,
                "decode_blocks": 0.0, "shared_tokens": 0.0}
         acc.update(stt.get("phase_acc") or {})
+        if acc.get("handoff_s") and not acc.get("decode_start_s"):
+            # the decode-side half of the handoff timestamp split
+            acc["decode_start_s"] = time.monotonic()
         self._phase_acc[rid] = acc
         trace_id = int(snap.get("trace_id") or 0)
         if trace_id:
@@ -1831,11 +1943,28 @@ class ServingEngine:
         b = ap["out_tp"].get("bias")
         return part + b if b is not None else part
 
+    def _mlp_tp(self, block, bp, x):
+        """Megatron MLP shard (prefill tier, ISSUE 19): fc1
+        column-split over "tp" (the local ``(D, F/tp)`` slice produces
+        local hidden activations), fc2 row-split (``(F/tp, D)`` partial
+        products) closed by the layer's SECOND psum, with the fc2 bias
+        added exactly once AFTER the reduce (the replicated
+        ``block.mlp`` adds it inside ``Linear``, which under a row
+        shard would add it ``tp`` times). Mathematically the replicated
+        MLP with the hidden-dim reduction reassociated at the shard
+        boundary."""
+        mp = bp["mlp"]
+        h = block.ln2(bp["ln2"], x)
+        h = block.mlp.act(jnp.matmul(h, mp["fc1"]["weight"])
+                          + mp["fc1"]["bias"])
+        part = jax.lax.psum(jnp.matmul(h, mp["fc2"]["weight"]), "tp")
+        return part + mp["fc2"]["bias"]
+
     # -- jitted step bodies ----------------------------------------------
 
     def _decode_loop(self, params, pages, block_tables, lengths, tokens,
                      active, n_valid=None, *, model=None, quantized=False,
-                     n_steps=1, tp=1, spmd=False):
+                     n_steps=1, tp=1, spmd=False, mlp_sharded=False):
         """The shared greedy token loop behind the decode step AND the
         draft-proposal step: ``n_steps`` inner iterations, each entering
         every slot's current token at position ``lengths[s]``, landing
@@ -1912,7 +2041,10 @@ class ServingEngine:
                 else:
                     x = x + block.attn.proj_out(bp["attn"],
                                                 att[:, :, None, :])
-                x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
+                if mlp_sharded:
+                    x = x + self._mlp_tp(block, bp, x)
+                else:
+                    x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
             x = model.ln_f(params["ln_f"], x)
             logits = jnp.einsum("bd,vd->bv", x[:, 0],
                                 params["wte"]["weight"])
@@ -1943,7 +2075,8 @@ class ServingEngine:
                                  tokens, active, model=self.model,
                                  quantized=self.quantized,
                                  n_steps=self.decode_block,
-                                 tp=self.tp, spmd=self.tp_spmd)
+                                 tp=self.tp, spmd=self.tp_spmd,
+                                 mlp_sharded=self._mlp_sharded)
 
     def _make_probe_pool(self):
         """Zero page pool for the collective probe: the real pool's
@@ -1991,7 +2124,8 @@ class ServingEngine:
 
     def _prefill_loop(self, params, pages, block_tables, starts, tokens,
                       n_valid, *, model=None, quantized=False,
-                      all_positions=False, tp=1, spmd=False):
+                      all_positions=False, tp=1, spmd=False,
+                      mlp_sharded=False):
         """The shared chunk-forward behind the batched prefill step, the
         draft prefill step, and the speculative VERIFY step: ``tokens``
         (S, C) enter at absolute positions ``starts[s]..starts[s]+C-1``
@@ -2061,7 +2195,10 @@ class ServingEngine:
             else:
                 x = x + block.attn.proj_out(bp["attn"],
                                             att.transpose(0, 2, 1, 3))
-            x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
+            if mlp_sharded:
+                x = x + self._mlp_tp(block, bp, x)
+            else:
+                x = x + block.mlp(bp["mlp"], block.ln2(bp["ln2"], x))
         x = model.ln_f(params["ln_f"], x)
         if all_positions:
             logits = jnp.einsum("scd,vd->scv", x,
@@ -2081,7 +2218,8 @@ class ServingEngine:
         return self._prefill_loop(params, pages, block_tables, starts,
                                   tokens, n_valid, model=self.model,
                                   quantized=self.quantized,
-                                  tp=self.tp, spmd=self.tp_spmd)
+                                  tp=self.tp, spmd=self.tp_spmd,
+                                  mlp_sharded=self._mlp_sharded)
 
     def _draft_prefill_step_impl(self, params, pages, block_tables,
                                  starts, tokens, n_valid):

@@ -53,6 +53,7 @@ class FleetAutoscaler:
                  scale_out_burn: float = 6.0, sustain_s: float = 2.0,
                  idle_occupancy: float = 0.1, idle_s: float = 5.0,
                  cooldown_s: float = 5.0, headroom_floor: float = 0.0,
+                 tiers: Optional[Dict[str, Dict]] = None,
                  registry=None, clock=time.monotonic):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -78,6 +79,40 @@ class FleetAutoscaler:
         self.scale_outs = 0
         self.scale_ins = 0
         self.events: List[Dict] = []
+        # disaggregated fleets (ISSUE 19) scale each tier on ITS
+        # binding resource: the prefill tier is flops-bound (queue wait
+        # and compute headroom), the decode tier is KV-capacity-bound
+        # (page/slot headroom). Each tier gets its own spawn factory,
+        # min/max, sustain/idle windows (shared durations) and
+        # cooldown. ``tiers=None`` keeps the single-pool behavior for
+        # colocated fleets bit-for-bit.
+        self.tiers: Optional[Dict[str, Dict]] = None
+        if tiers:
+            self.tiers = {}
+            for tname, tcfg in tiers.items():
+                if tname not in ("prefill", "decode"):
+                    raise ValueError(
+                        f"unknown tier {tname!r} (prefill/decode)")
+                if not callable(tcfg.get("spawn")):
+                    raise ValueError(
+                        f"tier {tname!r} needs a spawn callable")
+                tmin = int(tcfg.get("min", 1))
+                tmax = int(tcfg.get("max", max_replicas))
+                if tmin < 1 or tmax < tmin:
+                    raise ValueError(
+                        f"tier {tname!r}: bad min/max ({tmin}/{tmax})")
+                self.tiers[tname] = {
+                    "spawn": tcfg["spawn"], "min": tmin, "max": tmax,
+                    "queue_hot": int(tcfg.get("queue_hot", 4)),
+                    "headroom_floor": float(
+                        tcfg.get("headroom_floor", 0.25)),
+                }
+            self._tier_hot: Dict[str, Optional[float]] = {
+                t: None for t in self.tiers}
+            self._tier_idle: Dict[str, Optional[float]] = {
+                t: None for t in self.tiers}
+            self._tier_cooldown: Dict[str, float] = {
+                t: float("-inf") for t in self.tiers}
 
     def bind(self, router):
         self.router = router
@@ -138,6 +173,8 @@ class FleetAutoscaler:
         if self.router is None:
             raise RuntimeError("autoscaler not bound to a router")
         now = self._clock()
+        if self.tiers:
+            return self._tick_tiered(now)
         if now < self._cooldown_until:
             return None
         n = len(self.router.replicas)
@@ -255,3 +292,152 @@ class FleetAutoscaler:
                 "fleet.scale_in", duration_s=0.0, migrated=migrated,
                 replicas=len(self.router.replicas), replica=victim.name)
         return "scale_in"
+
+    # -- per-tier scaling (ISSUE 19) ---------------------------------------
+
+    def _tick_tiered(self, now: float) -> Optional[str]:
+        """One decision pass over each configured tier. Tiers are
+        independent — a hot prefill tier scales out while an idle
+        decode tier scales in, each behind its own cooldown."""
+        router = self.router
+        action = None
+        for tname, cfg in self.tiers.items():
+            if now < self._tier_cooldown[tname]:
+                continue
+            members = [r for r in router.replicas
+                       if router.replica_tier(r) == tname]
+            routable = [r for r in members if router.is_routable(r)]
+            # lost capacity first, same rule as the single pool
+            if len(routable) < cfg["min"] and len(members) < cfg["max"]:
+                action = self._tier_spawn(tname, cfg, "replace",
+                                          routable=len(routable))
+                continue
+            if (self._tier_pressure(tname, cfg, routable)
+                    and len(members) < cfg["max"]):
+                self._tier_idle[tname] = None
+                if self._tier_hot[tname] is None:
+                    self._tier_hot[tname] = now
+                if now - self._tier_hot[tname] >= self.sustain_s:
+                    action = self._tier_spawn(tname, cfg, "scale_out")
+                continue
+            self._tier_hot[tname] = None
+            if (len(members) > cfg["min"]
+                    and self._tier_is_idle(routable)):
+                if self._tier_idle[tname] is None:
+                    self._tier_idle[tname] = now
+                if now - self._tier_idle[tname] >= self.idle_s:
+                    action = self._tier_scale_in(
+                        tname, routable) or action
+                continue
+            self._tier_idle[tname] = None
+        return action
+
+    def _tier_pressure(self, tname: str, cfg: Dict, routable) -> bool:
+        """Tier-specific saturation: prefill is flops-bound (compute
+        headroom under the floor, or queued prompts piling up); decode
+        is KV-bound (page/slot headroom under the floor)."""
+        floor = cfg["headroom_floor"]
+        for rep in routable:
+            try:
+                h = rep.health()
+            except NotImplementedError:
+                raise
+            except Exception:
+                continue            # dying replica: the detector's job
+            head = h.get("headroom") or {}
+            if tname == "prefill":
+                if int(h.get("queue_depth", 0) or 0) >= cfg["queue_hot"]:
+                    return True
+                if float(head.get("flops", 1.0)) < floor:
+                    return True
+            else:
+                if min(float(head.get("pages", 1.0)),
+                       float(head.get("slots", 1.0))) < floor:
+                    return True
+        return False
+
+    def _tier_is_idle(self, members) -> bool:
+        for rep in members:
+            try:
+                h = rep.health()
+            except NotImplementedError:
+                raise
+            except Exception:
+                return False
+            if (int(h.get("queue_depth", 0) or 0) != 0
+                    or float(h.get("slot_occupancy", 0.0))
+                    > self.idle_occupancy):
+                return False
+        return bool(members)
+
+    def _tier_spawn(self, tname: str, cfg: Dict, action: str,
+                    routable: Optional[int] = None) -> str:
+        rep = cfg["spawn"](self._spawned)
+        self._spawned += 1
+        rep.warmup()        # every bucket compiled BEFORE first traffic
+        self.router.add_replica(rep)
+        self._tier_hot[tname] = None
+        self._tier_cooldown[tname] = self._clock() + self.cooldown_s
+        if action == "scale_out":
+            self.scale_outs += 1
+            self._reg.counter(
+                "fleet_scale_out_total",
+                "replicas added by the autoscaler").inc(tier=tname)
+        else:
+            self._reg.counter(
+                "fleet_replace_spawn_total",
+                "replicas spawned to replace lost capacity").inc(
+                    tier=tname)
+        ev = {"action": action, "tier": tname,
+              "replicas": len(self.router.replicas),
+              "replica": rep.name}
+        if routable is not None:
+            ev["routable"] = routable
+        self.events.append(ev)
+        if self.router.tracer.enabled:
+            self.router.tracer.record_span(
+                f"fleet.{action}", duration_s=0.0, tier=tname,
+                replicas=len(self.router.replicas), replica=rep.name)
+        return f"{action}:{tname}"
+
+    def _tier_scale_in(self, tname: str, routable) -> Optional[str]:
+        from paddle_tpu.serving.engine import SlotMigrationError
+        cands = [r for r in routable
+                 if not getattr(r, "draining", False)]
+        if not cands:
+            self._tier_idle[tname] = None
+            return None
+        victim = min(
+            cands,
+            key=lambda r: float(
+                r.health().get("requests_in_flight", 0)))
+        try:
+            migrated = self.router.drain_replica(victim)
+        except SlotMigrationError:
+            self._tier_idle[tname] = None
+            self._tier_cooldown[tname] = self._clock() + self.cooldown_s
+            self._reg.counter(
+                "fleet_scale_in_aborted_total",
+                "scale-in drains aborted for lack of peer capacity"
+            ).inc(tier=tname)
+            self.events.append({"action": "scale_in_aborted",
+                                "tier": tname, "replica": victim.name,
+                                "replicas": len(self.router.replicas)})
+            return None
+        self.scale_ins += 1
+        self._tier_idle[tname] = None
+        self._tier_cooldown[tname] = self._clock() + self.cooldown_s
+        self._reg.counter(
+            "fleet_scale_in_total",
+            "replicas drained by the autoscaler").inc(tier=tname)
+        self.events.append({"action": "scale_in", "tier": tname,
+                            "migrated": migrated,
+                            "replicas": len(self.router.replicas),
+                            "replica": victim.name})
+        if self.router.tracer.enabled:
+            self.router.tracer.record_span(
+                "fleet.scale_in", duration_s=0.0, tier=tname,
+                migrated=migrated,
+                replicas=len(self.router.replicas),
+                replica=victim.name)
+        return f"scale_in:{tname}"

@@ -77,6 +77,8 @@ class ChaosSpec:
     submit_failures: int = 0                # first K submits raise
     health_failures: int = 0                # first K health probes raise
     crash_on_snapshot: bool = False         # dies mid-drain
+    crash_on_handoff: bool = False          # prefill dies mid-handoff
+    crash_on_restore: bool = False          # decode dies mid-restore
 
 
 def chaos_schedule(seed: int, n_replicas: int, *,
@@ -217,6 +219,17 @@ class ChaosReplica:
         self._check()
         return self.inner.poll_checkpoints()
 
+    def poll_handoffs(self):
+        self._check()
+        if self.spec.crash_on_handoff:
+            # the prefill-tier chaos leg: the replica dies while the
+            # router is draining its handoff outbox — parked slots go
+            # down with it and must redrive through the replay records
+            self.dead = True
+            raise ReplicaCrashed(
+                f"chaos: {self.name} crashed mid-handoff")
+        return self.inner.poll_handoffs()
+
     def reject_reason(self, rid):
         self._check()
         return self.inner.reject_reason(rid)
@@ -227,6 +240,13 @@ class ChaosReplica:
 
     def restore(self, snap, *, parent_span=None):
         self._check()
+        if self.spec.crash_on_restore:
+            # the decode-tier chaos leg: the replica dies mid-restore —
+            # the router still holds the snapshot and must place it
+            # elsewhere (or fall back to the source) with nothing lost
+            self.dead = True
+            raise ReplicaCrashed(
+                f"chaos: {self.name} crashed mid-restore")
         return self.inner.restore(snap, parent_span=parent_span)
 
     def warmup(self):

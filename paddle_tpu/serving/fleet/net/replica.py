@@ -216,6 +216,10 @@ class NetReplica(ReplicaHandle):
         return [(int(r), snap)
                 for r, snap in self._call("poll_checkpoints", {})]
 
+    def poll_handoffs(self) -> List[Tuple[int, Dict]]:
+        return [(int(r), snap)
+                for r, snap in self._call("poll_handoffs", {})]
+
     def reject_reason(self, rid: int):
         out = self._call("reject_reason", {"rid": int(rid)})
         return None if out is None else wire.reject_from_wire(out)

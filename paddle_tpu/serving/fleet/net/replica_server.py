@@ -237,6 +237,8 @@ class ReplicaServer:
             return {"streams": rep.progress(since)}
         if op == "poll_checkpoints":
             return rep.poll_checkpoints()
+        if op == "poll_handoffs":
+            return rep.poll_handoffs()
         if op == "reject_reason":
             rej = rep.reject_reason(int(a["rid"]))
             return None if rej is None else wire.reject_to_wire(rej)

@@ -118,6 +118,15 @@ class ReplicaHandle:
         seed that bounds re-decode work after a crash."""
         return []
 
+    def poll_handoffs(self) -> List[Tuple[int, Dict]]:
+        """Drain a prefill-tier replica's handoff outbox (``(rid,
+        snapshot)`` pairs — see ``ServingEngine.poll_handoffs``): every
+        parked prefill-done slot, snapshotted in the migration transfer
+        format and already released. The two-tier router streams each
+        snapshot to a decode-tier peer's ``restore``. Empty on
+        non-prefill replicas."""
+        return []
+
     def reject_reason(self, rid: int):
         """Structured reject for a request the replica's own engine
         shed after queueing (TTFT deadline expired before admission);
@@ -309,6 +318,10 @@ class LocalReplica(ReplicaHandle):
     def poll_checkpoints(self) -> List[Tuple[int, Dict]]:
         with self._lock:
             return list(self.engine.poll_micro_snapshots().items())
+
+    def poll_handoffs(self) -> List[Tuple[int, Dict]]:
+        with self._lock:
+            return list(self.engine.poll_handoffs())
 
     def reject_reason(self, rid: int):
         with self._lock:

@@ -97,7 +97,7 @@ class _FleetRequest:
     checkpoint: Optional[Dict] = None
 
 
-@guarded_by("_view_lock", "_postmortems")
+@guarded_by("_view_lock", "_postmortems", "_tiers")
 class FleetRouter:
     """Single front door over N :class:`ReplicaHandle` replicas.
 
@@ -139,6 +139,7 @@ class FleetRouter:
         self._results_cap = 1024
         self._rr = 0                           # round-robin cursor
         self.migrations_total = 0
+        self.handoffs_total = 0
         self.routed_affinity_total = 0
         self.routed_balance_total = 0
         # involuntary-failure machinery (ISSUE 14): replay records for
@@ -164,6 +165,11 @@ class FleetRouter:
         # through postmortems()/health()
         self._view_lock = threading.Lock()
         self._postmortems: "deque" = deque(maxlen=16)
+        # serving tier per replica ("prefill"/"decode"/"colocated") —
+        # immutable per engine, cached on first successful health().
+        # Crosses threads: the pump caches during step()/submit() while
+        # the exposition HTTP thread may trigger a lookup via health()
+        self._tiers: Dict[int, str] = {}
         self._sheds_since_dump = 0
         self._postmortem_seq = 0
 
@@ -251,6 +257,82 @@ class FleetRouter:
             return cands
         return [r for r in cands if self._breaker(r).allow()]
 
+    # -- disaggregation (ISSUE 19) -----------------------------------------
+
+    def replica_tier(self, rep) -> str:
+        """Serving tier of one replica: ``"prefill"``, ``"decode"``, or
+        ``"colocated"``. The tier is fixed at engine construction, so
+        the first successful ``health()`` read is cached; an
+        unreachable replica reads as ``"colocated"`` WITHOUT caching
+        (the next call re-asks). A failed read here is a transport
+        failure like any other: it feeds the breaker and the
+        consecutive-failure count exactly as a probe failure would —
+        swallowing it would let the tier lookup silently absorb health
+        flakes the detection loop needs to see. A breaker-open replica
+        is already quarantined and is not asked at all."""
+        with self._view_lock:
+            tier = self._tiers.get(id(rep))
+        if tier is not None:
+            return tier
+        if self.faults.enabled:
+            b = self._breakers.get(id(rep))
+            if b is not None and b.state == CircuitBreaker.OPEN:
+                return "colocated"
+        try:
+            h = rep.health()
+        except NotImplementedError:
+            raise
+        except Exception as e:
+            if not self.faults.enabled:
+                raise
+            if not isinstance(e, ReplicaCrashed):
+                self._breaker(rep).record_failure()
+            reason = self._detector.observe_failure(rep.name, e)
+            if reason is not None and rep in self.replicas:
+                self.eject_replica(rep, reason=reason)
+            return "colocated"
+        tier = str(h.get("tier") or "colocated")
+        with self._view_lock:
+            self._tiers[id(rep)] = tier
+        return tier
+
+    def _prompt_candidates(self, exclude=None):
+        """Candidates for a FRESH prompt. Decode-tier replicas only
+        take restored prefill-complete slots — routing them a prompt
+        would be refused by the engine anyway (``ValueError``), so they
+        are filtered here and the router never even tries."""
+        return [r for r in self._candidates(exclude)
+                if self.replica_tier(r) != "decode"]
+
+    def _flops_headroom(self, rep) -> float:
+        """Prefill placement signal: the flops headroom the engine's
+        resource plane publishes (1 = idle compute, 0 = saturated)."""
+        try:
+            h = rep.health()
+        except NotImplementedError:
+            raise
+        except Exception:
+            if not self.faults.enabled:
+                raise
+            return -1.0
+        return float((h.get("headroom") or {}).get("flops", 0.0))
+
+    def _decode_headroom(self, rep) -> float:
+        """Decode placement signal: a restored slot needs pages AND a
+        free slot, so the binding resource is the min of the two
+        headrooms."""
+        try:
+            h = rep.health()
+        except NotImplementedError:
+            raise
+        except Exception:
+            if not self.faults.enabled:
+                raise
+            return -1.0
+        hd = h.get("headroom") or {}
+        return min(float(hd.get("pages", 0.0)),
+                   float(hd.get("slots", 0.0)))
+
     def _pick_p2c(self, cands):
         if len(cands) == 1:
             return cands[0]
@@ -258,10 +340,19 @@ class FleetRouter:
         return a if self._load(a) <= self._load(b) else b
 
     def _route(self, prompt, exclude=None):
-        """(replica, affinity_pages) for this prompt."""
-        cands = self._candidates(exclude)
+        """(replica, affinity_pages) for this prompt. Routes PROMPTS,
+        so decode-tier replicas are never candidates; in a
+        disaggregated fleet the prefill tier is preferred and the
+        balance pick is by flops headroom (prefill is flops-bound —
+        queue depth alone misreads a replica mid-chunked-prefill)."""
+        cands = self._prompt_candidates(exclude)
         if not cands:
             raise SlotMigrationError("no routable replica")
+        pre = [r for r in cands
+               if self.replica_tier(r) == "prefill"]
+        tiered = bool(pre)
+        if tiered:
+            cands = pre
         if self.faults.enabled:
             # a half-open breaker needs its probe request SENT, not
             # left to sampling chance: route the next request there
@@ -295,7 +386,8 @@ class FleetRouter:
                 if best is not None and best_hits > 0:
                     self.routed_affinity_total += 1
                     return best, best_hits
-        rep = self._pick_p2c(cands)
+        rep = (max(cands, key=self._flops_headroom) if tiered
+               else self._pick_p2c(cands))
         self.routed_balance_total += 1
         return rep, 0
 
@@ -333,7 +425,7 @@ class FleetRouter:
                     if enabled:
                         self._breaker(rep).record_success(trace_id)
                     tried.append(rep)
-                    rest = [r for r in self._candidates()
+                    rest = [r for r in self._prompt_candidates()
                             if r not in tried]
                     if not rest:
                         if span is not None:
@@ -346,7 +438,7 @@ class FleetRouter:
                         raise
                     self._note_transport_failure(rep, e, trace_id)
                     tried.append(rep)
-                    rest = [r for r in self._candidates()
+                    rest = [r for r in self._prompt_candidates()
                             if r not in tried]
                     if not rest:
                         if span is not None:
@@ -554,9 +646,125 @@ class FleetRouter:
             if enabled:
                 self._poll_progress(rep)
                 self._reconcile_rejects(rep)
+        self._pump_handoffs()
         if self.autoscaler is not None:
             self.autoscaler.tick()
         return finished
+
+    # -- prefill -> decode streaming (ISSUE 19) ----------------------------
+
+    def _pump_handoffs(self):
+        """Drain every prefill-tier replica's handoff outbox and place
+        each prefill-complete slot onto the decode tier. Runs every
+        fleet step regardless of ``faults.enabled`` — disaggregation is
+        a serving mode, not a fault feature."""
+        for rep in list(self.replicas):
+            if rep not in self.replicas:
+                continue            # ejected mid-sweep
+            if self.replica_tier(rep) != "prefill":
+                continue
+            try:
+                handoffs = rep.poll_handoffs()
+            except NotImplementedError:
+                raise
+            except Exception as e:
+                if not self.faults.enabled:
+                    raise
+                # the slots were snapshotted-or-kept atomically by the
+                # engine, so a crash here loses no request: the eject
+                # path redrives from the replay records
+                reason = self._detector.observe_failure(rep.name, e)
+                if reason is not None and rep in self.replicas:
+                    self.eject_replica(rep, reason=reason)
+                continue
+            for lrid, snap in handoffs:
+                self._place_handoff(rep, lrid, snap)
+
+    def _place_handoff(self, src, lrid, snap):
+        """Place one prefill-complete snapshot onto the decode replica
+        with the most page/slot headroom (decode is bandwidth-bound —
+        the binding resource is KV capacity, not compute). Placement
+        failure falls back to restoring the snapshot into the SOURCE
+        with the ``decode_in_place`` marker — the prefill engine
+        finishes the decode itself rather than losing the request; if
+        even the source cannot take it back, the request redrives from
+        its replay record. A handoff NEVER loses a request."""
+        frid = self._rev.pop((id(src), lrid), None)
+        if frid is not None:
+            self._where.pop(frid, None)
+        tid = int(snap.get("trace_id") or 0)
+        span = None
+        if self.tracer.enabled:
+            span = self.tracer.start_span(
+                "router.handoff", trace_id=tid or None, src=src.name)
+        nbytes = sum(int(m.get("bytes", 0))
+                     for m in snap.get("manifest", ()))
+        decoders = sorted(
+            (r for r in self._candidates(exclude=src)
+             if self.replica_tier(r) == "decode"),
+            key=self._decode_headroom, reverse=True)
+        for peer in decoders:
+            try:
+                nrid = peer.restore(snap, parent_span=span)
+            except NotImplementedError:
+                raise
+            except SlotMigrationError:
+                continue            # no capacity there: next decoder
+            except TRANSPORT_ERRORS as e:
+                if not self.faults.enabled:
+                    raise
+                self._note_transport_failure(peer, e, tid)
+                continue
+            self._note_transport_success(peer, tid)
+            if frid is not None:
+                self._where[frid] = (peer, nrid)
+                self._rev[(id(peer), nrid)] = frid
+                rec = self._reqs.get(frid)
+                if rec is not None:
+                    rec.observed = list(rec.committed) + [
+                        int(t) for t in snap["state"]["generated"]]
+            self.handoffs_total += 1
+            self._reg.counter(
+                "fleet_handoff_total",
+                "prefill-complete slots streamed to the decode "
+                "tier").inc(src=src.name, dst=peer.name)
+            self._reg.counter(
+                "fleet_handoff_bytes_total",
+                "sha256-verified page bytes shipped prefill -> "
+                "decode").inc(nbytes, src=src.name, dst=peer.name)
+            if span is not None:
+                span.set_attrs(dst=peer.name, bytes=nbytes,
+                               kv_tokens=int(snap["state"]["length"]))
+                span.finish()
+            return
+        back = dict(snap)
+        back["decode_in_place"] = True
+        try:
+            nrid = src.restore(back, parent_span=span)
+        except NotImplementedError:
+            raise
+        except Exception:
+            # source slot already freed and unplaceable anywhere: the
+            # replay record (prompt + observed tokens) redrives it —
+            # structured Reject at worst, never silent loss
+            if span is not None:
+                span.finish(status="redrive")
+            if frid is not None:
+                rec = self._reqs.get(frid)
+                if rec is not None:
+                    rec.observed = list(rec.committed) + [
+                        int(t) for t in snap["state"]["generated"]]
+                self._redrive(frid, src=src.name)
+            return
+        if frid is not None:
+            self._where[frid] = (src, nrid)
+            self._rev[(id(src), nrid)] = frid
+        self._reg.counter(
+            "fleet_handoff_fallback_total",
+            "handoffs decoded in place on the prefill tier (no "
+            "decode capacity)").inc(replica=src.name)
+        if span is not None:
+            span.finish(status="decode_in_place")
 
     def _finish(self, rep, lrid, toks) -> Dict[int, np.ndarray]:
         frid = self._rev.pop((id(rep), lrid), None)
@@ -678,6 +886,7 @@ class FleetRouter:
             "recompiles": sum(int(h.get("recompiles", 0) or 0)
                               for h in per.values()),
             "migrations_total": self.migrations_total,
+            "handoffs_total": self.handoffs_total,
             "routable": self.routable_count(),
             "ejected_total": self.ejected_total,
             "redrives_total": self.redrives_total,
@@ -881,8 +1090,8 @@ class FleetRouter:
             first, _hits = self._route(new_prompt)
         except SlotMigrationError:
             return self._shed_redrive(frid, rec, "no_replica", src)
-        others = sorted((r for r in self._candidates() if r is not first),
-                        key=self._load)
+        others = sorted((r for r in self._prompt_candidates()
+                         if r is not first), key=self._load)
         last_shed: Optional[LoadShedError] = None
         for peer in [first] + others:
             try:
@@ -1024,7 +1233,7 @@ class FleetRouter:
             frid = self._rev.pop((id(rep), lrid), None)
             trace_id = self._trace.get(frid, 0) if frid else 0
             first, _hits = self._route(prompt, exclude=rep)
-            others = sorted((r for r in self._candidates(exclude=rep)
+            others = sorted((r for r in self._prompt_candidates(exclude=rep)
                              if r is not first), key=self._load)
             nrid, target = None, None
             for peer in [first] + others:
@@ -1213,6 +1422,13 @@ class FleetMonitor:
         for name, rh in h["per_replica"].items():
             occ.append(float(rh.get("slot_occupancy", 0.0)))
             util.append(float(rh.get("page_utilization", 0.0)))
+            # disaggregation (ISSUE 19): tiered replicas carry their
+            # tier on every per-replica series; colocated fleets keep
+            # the exact pre-tier label sets (dashboards and exact-label
+            # value() lookups stay byte-identical)
+            tier = str(rh.get("tier") or "colocated")
+            lbl = ({"replica": name} if tier == "colocated"
+                   else {"replica": name, "tier": tier})
             # resource-headroom plane (ISSUE 16): per-replica gauges +
             # the fleet-level bottleneck (min across replicas) the
             # autoscaler and /healthz read
@@ -1222,23 +1438,23 @@ class FleetMonitor:
                     g("fleet_replica_headroom",
                       "per-replica resource headroom "
                       "(1 = idle, 0 = saturated)").set(
-                          v, replica=name, resource=res)
+                          v, resource=res, **lbl)
                     head_min[res] = min(head_min.get(res, 1.0), v)
             g("fleet_replica_queue_depth",
               "per-replica queued requests").set(
-                  rh.get("queue_depth", 0), replica=name)
+                  rh.get("queue_depth", 0), **lbl)
             g("fleet_replica_slot_occupancy",
               "per-replica decode-slot occupancy").set(
-                  rh.get("slot_occupancy", 0.0), replica=name)
+                  rh.get("slot_occupancy", 0.0), **lbl)
             g("fleet_replica_tp",
               "per-replica tensor-parallel degree (mesh chips)").set(
-                  rh.get("mesh_devices", 1) or 1, replica=name)
+                  rh.get("mesh_devices", 1) or 1, **lbl)
             slo = rh.get("slo")
             if slo:
                 burn.append(float(slo.get("burn_fast", 0.0)))
                 g("fleet_replica_burn_rate",
                   "per-replica fast-window SLO burn").set(
-                      slo.get("burn_fast", 0.0), replica=name)
+                      slo.get("burn_fast", 0.0), **lbl)
         if occ:
             g("fleet_slot_occupancy_mean",
               "mean decode-slot occupancy").set(sum(occ) / len(occ))

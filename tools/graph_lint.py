@@ -358,6 +358,32 @@ def lint_serving_prefill_tp(suppressions, cost=False):
         suppressions=suppressions, cost=cost)
 
 
+def lint_serving_prefill_tp_mlp(suppressions, cost=False):
+    """The prefill-TIER tensor-parallel batched-prefill step
+    (ISSUE 19): a disaggregated prefill engine runs the real Megatron
+    MLP shard (fc1 column-split, fc2 row-split) on top of the sharded
+    attention, so its lowered step carries exactly TWO all_reduce
+    psums per layer — attention output plus MLP row-parallel
+    reduction. The ``collective_allowlist`` stays ``["all_reduce"]``
+    and the extra collective BYTES are budget-gated by ``--cost-diff``;
+    the colocated/decode surfaces above must stay byte-identical
+    (+0.0%) because the shard is gated to ``tier="prefill"``."""
+    import jax.numpy as jnp
+
+    eng = _tiny_tp_engine(tier="prefill")
+    c = eng.cache.config
+    return analysis.lint_fn(
+        eng.prefill_step, analysis.abstractify(eng._step_params),
+        analysis.abstractify(eng.cache.pages),
+        jax.ShapeDtypeStruct((c.num_slots, c.max_pages_per_slot),
+                             jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots, eng.prefill_chunk), jnp.int32),
+        jax.ShapeDtypeStruct((c.num_slots,), jnp.int32),
+        name="serving_prefill_tp_mlp", ast_fn=eng._prefill_loop,
+        suppressions=suppressions, cost=cost)
+
+
 def lint_embedding_install(suppressions, cost=False):
     """The embedding-serving cache's update step: the device hot-row
     table is DONATED into the bucketed scatter (the engine replaces its
@@ -459,7 +485,8 @@ PRESETS = {
                   lint_convgroup, lint_serving_decode,
                   lint_serving_prefill, lint_serving_decode_int8,
                   lint_serving_prefill_int8, lint_serving_decode_tp,
-                  lint_serving_prefill_tp, lint_embedding_install,
+                  lint_serving_prefill_tp, lint_serving_prefill_tp_mlp,
+                  lint_embedding_install,
                   lint_embedding_lookup, lint_kernel_registry],
 }
 

@@ -279,6 +279,42 @@ print("net_router + socket chaos dryrun OK (overhead=%.3fms/token, "
 python tools/check_metrics_log.py --netlog /tmp/BENCH_NET.netlog.jsonl \
   --require-requests 4
 
+# disaggregation bench smoke (ISSUE 19): the two-tier fleet (flops-bound
+# prefill replicas streaming sha256 shard manifests into KV-bound decode
+# replicas) must run the mixed burst end-to-end on CPU — interactive
+# TTFT p99 at least 2x better than the colocated fleet, decode
+# throughput within 10% by busy-time accounting, greedy outputs
+# bit-identical, transfer bytes metered under the page-math budget, and
+# zero steady-state recompiles on BOTH tiers (each tier warms only its
+# own bucket plan)
+echo "== bench smoke (disagg dryrun) =="
+DISAGG_OUT="$(python bench.py --model disagg --dryrun)"
+if echo "$DISAGG_OUT" | grep -q '"error"'; then
+  echo "disagg bench dryrun failed: $DISAGG_OUT"
+  exit 1
+fi
+echo "$DISAGG_OUT" | python -c '
+import json, sys
+r = json.load(sys.stdin)
+for k in ("ttft_interactive_p99_s", "ttft_ratio",
+          "decode_tokens_per_s_busy", "throughput_ratio",
+          "greedy_identical", "recompiles_after_warmup", "handoffs",
+          "transfer_bytes", "transfer_budget_bytes"):
+    assert k in r, f"BENCH_DISAGG missing {k}"
+assert r["greedy_identical"] is True, \
+    "disaggregated greedy outputs diverged from colocated"
+assert r["handoffs"] >= 1, "no prefill->decode handoff happened"
+for tier in ("prefill", "decode", "colocated"):
+    assert r["recompiles_after_warmup"][tier] == 0, \
+        (tier, "recompiled in steady state")
+assert 0 < r["transfer_bytes"] <= r["transfer_budget_bytes"], \
+    "handoff transfer bytes unmetered or over the page-math budget"
+assert r["ttft_ratio"] > 0 and r["throughput_ratio"] > 0
+print("disagg dryrun OK (ttft %.2fx, throughput %.2fx, %d handoffs, "
+      "%d transfer bytes)" % (r["ttft_ratio"], r["throughput_ratio"],
+                              r["handoffs"], r["transfer_bytes"]))
+'
+
 # kernel-layer bench smoke: the shared autotuner must measure all three
 # single-device Pallas kernels (flash, ragged decode, ragged prefill)
 # across 3 shape buckets through ONE dispatch harness, hit its cache on
