@@ -1190,7 +1190,7 @@ def run_bench_router(dev, dryrun=False):
     monitor = fleet.FleetMonitor(router_x, registry=reg)
     mon_h = monitor.collect()
     headroom = mon_h["headroom"]
-    if set(headroom) != {"flops", "pages", "slots", "hbm"}:
+    if set(headroom) != {"flops", "pages", "slots", "hbm", "spill"}:
         raise RuntimeError(f"fleet headroom plane incomplete: {headroom}")
     if any(not (0.0 <= float(v) <= 1.0) for v in headroom.values()):
         raise RuntimeError(f"fleet headroom out of range: {headroom}")
@@ -2836,6 +2836,267 @@ def run_bench_disagg(dev, dryrun=False):
     return result
 
 
+def prefix_fleet_json_path(dryrun: bool) -> str:
+    import os
+    if dryrun:  # CI smoke must not dirty the checkout
+        return os.environ.get("PADDLE_TPU_BENCH_PREFIX_FLEET",
+                              "/tmp/BENCH_PREFIX_FLEET.json")
+    return os.environ.get(
+        "PADDLE_TPU_BENCH_PREFIX_FLEET",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_PREFIX_FLEET.json"))
+
+
+def run_bench_prefix_fleet(dev, dryrun=False):
+    """Hierarchical KV (ISSUE 20 acceptance): host-spilled cold pages
+    plus fleet-global prefix fetch, against the affinity-only router
+    it extends, under the SAME shared-prefix workload with scale-out
+    AND scale-in churn.
+
+    Two fleets, identical chips and identical traffic:
+
+    - **affinity-only** — ``prefix_fetch=False, host_spill_pages=0``:
+      routing chases the prefix holder, but a miss (or an evicted
+      page) re-prefills from scratch, and a drained holder takes its
+      prefix pages to the grave.
+    - **hierarchical** — allocator pressure spills published pages to
+      a pinned host pool (restored byte-identical on the next hit),
+      and a replica that misses a prefix a peer advertises imports
+      the committed pages as hash-verified migration shards instead
+      of recomputing them.
+
+    The churn script (identical in both legs): wave A publishes the
+    shared prefixes and adds filler pressure on a 2-replica fleet; a
+    THIRD warmed replica scales out; every prefix holder starts
+    draining (drain refuses new work, so wave B must route to the
+    non-holders — the hierarchical leg fetches, the baseline
+    re-prefills); the holders are then drain-removed (scale-in) and
+    wave C runs on the survivors.
+
+    Headline metric: fleet prefill tokens actually COMPUTED per
+    served token (``serving_prefill_tokens_total`` summed over every
+    engine that ever served, divided by ``serving_tokens_total`` —
+    lower is better). Gates (hard non-dryrun):
+
+    - the hierarchical fleet must be STRICTLY below affinity-only;
+    - greedy outputs bit-identical across the two legs (sharing and
+      fetching never change tokens);
+    - ZERO steady-state recompiles on every replica in BOTH legs
+      (spill/restore and page import ride the warmed
+      ``("page_read",)``/``("page_write",)`` signatures);
+    - the hierarchical leg actually exercised BOTH tiers: fetched
+      pages > 0 and spilled pages > 0.
+
+    Emits BENCH_PREFIX_FLEET.json (schema self-validated) next to
+    this file (dryrun: /tmp)."""
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.serving.paged_cache import prompt_prefix_digests
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    if dryrun:
+        cfg = GPTConfig.tiny(vocab_size=64, hidden_size=16,
+                             num_layers=2, num_heads=2, ffn_size=32,
+                             max_position=64, dropout=0.0,
+                             attn_impl="xla")
+        reqs_per_prefix = 2
+    else:
+        cfg = GPTConfig.tiny(vocab_size=256, hidden_size=64,
+                             num_layers=2, num_heads=4, ffn_size=128,
+                             max_position=64, dropout=0.0,
+                             attn_impl="xla")
+        reqs_per_prefix = 3
+    page_size, prefix_len, cap = 4, 16, 6
+    num_pages, spill_pages = 14, 8
+    filler_len = 24
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    vocab = cfg.vocab_size
+    prefixes = [rng.integers(1, vocab, prefix_len).astype(np.int32)
+                for _ in range(2)]
+    prefix_digs = set()
+    for pre in prefixes:
+        prefix_digs.update(prompt_prefix_digests(pre, page_size))
+
+    def shared(pre):
+        tail = rng.integers(1, vocab,
+                            int(rng.integers(2, 5))).astype(np.int32)
+        return np.concatenate([pre, tail])
+
+    def filler():
+        return rng.integers(1, vocab, filler_len).astype(np.int32)
+
+    # one deterministic prompt script, replayed by BOTH legs
+    wave_a = [shared(p) for p in prefixes
+              for _ in range(reqs_per_prefix)] + [filler(), filler()]
+    wave_b = [shared(p) for p in prefixes
+              for _ in range(reqs_per_prefix)] + [filler()]
+    wave_c = [shared(p) for p in prefixes
+              for _ in range(reqs_per_prefix)]
+    served_cap = cap * (len(wave_a) + len(wave_b) + len(wave_c))
+
+    t_bench0 = time.perf_counter()
+
+    def make_replica(name, spill):
+        eng = serving.ServingEngine(
+            model, params, num_slots=2, page_size=page_size,
+            num_pages=num_pages, max_tokens_per_slot=44,
+            prefill_chunk=page_size, attn_impl="lax",
+            registry=obs.MetricsRegistry(), host_spill_pages=spill)
+        return fleet.LocalReplica(eng, name=name).warmup()
+
+    def run_wave(router, prompts, outs):
+        frids = [router.submit(p, cap) for p in prompts]
+        router.run_until_idle(max_steps=200_000)
+        for f in frids:
+            o = router.result(f)
+            if o is None:
+                raise RuntimeError("prefix_fleet wave lost a request")
+            outs.append(o)
+
+    def leg(prefix_fetch, spill):
+        reps = [make_replica(f"r{i}", spill) for i in range(2)]
+        reg = obs.MetricsRegistry()
+        router = fleet.FleetRouter(reps, policy="affinity",
+                                   registry=reg, seed=9,
+                                   prefix_fetch=prefix_fetch)
+        all_reps = list(reps)
+        outs = []
+        run_wave(router, wave_a, outs)
+        # scale-out churn: a fresh warmed replica joins mid-traffic
+        extra = make_replica("r2", spill)
+        router.add_replica(extra)
+        all_reps.append(extra)
+        # every prefix holder starts draining — wave B MUST land on
+        # replicas that never saw the prefixes (drain refuses new
+        # work, but exporting committed pages is a read)
+        holders = [r for r in reps
+                   if prefix_digs & set(r.prefix_digests())]
+        if not holders:
+            raise RuntimeError("wave A published no shared prefix")
+        for h in holders:
+            h.draining = True
+        run_wave(router, wave_b, outs)
+        # scale-in churn: the holders leave the fleet for good
+        for h in holders:
+            router.drain_replica(h, remove=True)
+        run_wave(router, wave_c, outs)
+        prefill = sum(float(r.engine._reg.counter(
+            "serving_prefill_tokens_total").value()) for r in all_reps)
+        served = sum(float(r.engine._reg.counter(
+            "serving_tokens_total").value()) for r in all_reps)
+        shared_tok = sum(float(r.engine._reg.counter(
+            "serving_prefix_shared_tokens_total").value())
+            for r in all_reps)
+        recompiles = sum(int(r.engine.recompile_detector.recompiles)
+                         for r in all_reps)
+        spilled = sum(int(r.engine.cache.spill_pool.spilled_total)
+                      for r in all_reps if r.engine.cache.spill_pool)
+        spilled_bytes = sum(
+            int(r.engine.cache.spill_pool.spilled_bytes_total)
+            for r in all_reps if r.engine.cache.spill_pool)
+        restored = sum(int(r.engine.cache.spill_pool.restored_total)
+                       for r in all_reps if r.engine.cache.spill_pool)
+        return {
+            "outs": outs, "router_reg": reg,
+            "prefill_tokens": prefill, "served_tokens": served,
+            "prefill_per_served": prefill / max(served, 1e-9),
+            "shared_tokens": shared_tok,
+            "prefix_hit_rate": round(
+                shared_tok / max(prefill + shared_tok, 1e-9), 4),
+            "recompiles": recompiles,
+            "spilled_pages": spilled, "spilled_bytes": spilled_bytes,
+            "restored_pages": restored,
+        }
+
+    base = leg(prefix_fetch=False, spill=0)
+    hier = leg(prefix_fetch=True, spill=spill_pages)
+
+    if not all(np.array_equal(a, b)
+               for a, b in zip(base["outs"], hier["outs"])):
+        raise RuntimeError("hierarchical greedy tokens diverged from "
+                           "the affinity-only fleet")
+    if base["recompiles"] or hier["recompiles"]:
+        raise RuntimeError(
+            f"steady-state recompiles after warmup: affinity-only="
+            f"{base['recompiles']} hierarchical={hier['recompiles']}")
+    hreg = hier["router_reg"]
+    fetched_pages = int(hreg.counter(
+        "fleet_prefix_fetch_pages_total").value())
+    fetched_bytes = int(hreg.counter(
+        "fleet_prefix_fetch_bytes_total").value())
+    degraded = int(hreg.counter(
+        "fleet_prefix_fetch_degraded_total").value())
+    ratio = (base["prefill_per_served"]
+             / max(hier["prefill_per_served"], 1e-9))
+    if not dryrun:
+        if hier["prefill_per_served"] >= base["prefill_per_served"]:
+            raise RuntimeError(
+                f"hierarchical prefill/served "
+                f"{hier['prefill_per_served']:.3f} not strictly below "
+                f"affinity-only {base['prefill_per_served']:.3f}")
+        if fetched_pages <= 0:
+            raise RuntimeError("fleet prefix fetch never fired")
+        if hier["spilled_pages"] <= 0:
+            raise RuntimeError("host spill tier never engaged")
+
+    result = {
+        "metric": "prefix_fleet_prefill_tokens_per_served_token",
+        "value": round(hier["prefill_per_served"], 4),
+        "unit": "prefill tokens/served token (lower is better)",
+        "vs_baseline": round(ratio, 3),
+        "prefill_per_served": {
+            "affinity_only": round(base["prefill_per_served"], 4),
+            "hierarchical": round(hier["prefill_per_served"], 4)},
+        "prefill_tokens": {
+            "affinity_only": int(base["prefill_tokens"]),
+            "hierarchical": int(hier["prefill_tokens"])},
+        "served_tokens": {
+            "affinity_only": int(base["served_tokens"]),
+            "hierarchical": int(hier["served_tokens"])},
+        "prefix_hit_rate": {
+            "affinity_only": base["prefix_hit_rate"],
+            "hierarchical": hier["prefix_hit_rate"]},
+        "fetch": {"pages": fetched_pages, "bytes": fetched_bytes,
+                  "degraded": degraded},
+        "spill": {"spilled_pages": hier["spilled_pages"],
+                  "spilled_bytes": hier["spilled_bytes"],
+                  "restored_pages": hier["restored_pages"]},
+        "greedy_identical": True,
+        "recompiles_after_warmup": {
+            "affinity_only": base["recompiles"],
+            "hierarchical": hier["recompiles"]},
+        "churn": {"scale_out_replicas": 1, "drained_holders": True},
+        "workload": {"prefixes": len(prefixes),
+                     "prefix_len": prefix_len,
+                     "requests": (len(wave_a) + len(wave_b)
+                                  + len(wave_c)),
+                     "cap": cap, "served_cap": served_cap,
+                     "filler_len": filler_len},
+        "bench_wall_s": round(time.perf_counter() - t_bench0, 1),
+        "device": str(dev.device_kind if hasattr(dev, "device_kind")
+                      else dev.platform),
+        "dryrun": bool(dryrun),
+    }
+    # schema self-check before the file lands
+    for k in ("prefill_per_served", "prefill_tokens", "served_tokens",
+              "prefix_hit_rate", "fetch", "spill", "greedy_identical",
+              "recompiles_after_warmup", "churn"):
+        if k not in result:
+            raise RuntimeError(f"BENCH_PREFIX_FLEET schema "
+                               f"self-check failed: missing {k}")
+    path = prefix_fleet_json_path(dryrun)
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    result["json"] = path
+    return result
+
+
 def run_bench_kernels(dev, dryrun=False):
     """Shared kernel-layer microbench (ISSUE 12 acceptance): for every
     registered single-device kernel (flash attention, ragged paged
@@ -2979,6 +3240,9 @@ _BENCHES = {
                    "tokens/s"),
     "disagg": (run_bench_disagg, "serving_disagg_ttft_p99_improvement",
                "x vs colocated (mixed burst)"),
+    "prefix_fleet": (run_bench_prefix_fleet,
+                     "prefix_fleet_prefill_tokens_per_served_token",
+                     "prefill tokens/served token (lower is better)"),
 }
 
 
@@ -2997,7 +3261,8 @@ def main():
         obs.install_compile_listener()  # compiles_cum covers the warmup
         dev, degraded = acquire_device()
         if which in ("serving", "embedding_serving", "router", "kernels",
-                     "serving_tp", "net_router", "disagg"):
+                     "serving_tp", "net_router", "disagg",
+                     "prefix_fleet"):
             # CI smoke: tiny sizes + schema self-check
             result = _BENCHES[which][0](dev,
                                         dryrun="--dryrun" in sys.argv)

@@ -125,8 +125,9 @@ import numpy as np
 
 from paddle_tpu.analysis.concurrency import guarded_by
 from paddle_tpu.serving import decode_attention as DA
-from paddle_tpu.serving.paged_cache import (PagedCacheConfig, PagedKVCache,
-                                            quantize_kv)
+from paddle_tpu.serving.paged_cache import (_ROOT_KEY, _chain,
+                                            PagedCacheConfig, PagedKVCache,
+                                            payload_digest, quantize_kv)
 from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                           Reject, Request, SLOScheduler,
                                           SlotState)
@@ -142,6 +143,12 @@ _LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.35,
                     15.0, 30.0, 60.0)
 
 MIGRATION_FORMAT = "paddle_tpu.serving.slot-migration-v1"
+
+# fleet-global prefix reuse (ISSUE 20): committed prefix pages travel
+# between replicas in the SAME per-(page, tp-shard) sha256 shard layout
+# as slot migration, wrapped per published page with its chain key and
+# token content so the importer can re-verify the whole hash chain
+PREFIX_BUNDLE_FORMAT = "paddle_tpu.serving.prefix-pages-v1"
 
 
 class SlotMigrationError(RuntimeError):
@@ -182,7 +189,8 @@ class ServingEngine:
                  mesh=None, tp: Optional[int] = None,
                  tp_probe: bool = False,
                  anatomy_probe_every: Optional[int] = None,
-                 tier: str = "colocated"):
+                 tier: str = "colocated",
+                 host_spill_pages: int = 0):
         cfg = model.cfg
         if cfg.pipeline or cfg.stacked_layers:
             raise ValueError(
@@ -310,7 +318,8 @@ class ServingEngine:
             num_slots=num_slots, page_size=page_size, num_pages=num_pages,
             max_pages_per_slot=max_pages_per_slot, dtype=dtype,
             share_prefix=prefix_sharing),
-            mesh=mesh if self.tp_spmd else None)
+            mesh=mesh if self.tp_spmd else None,
+            host_spill_pages=host_spill_pages)
         self.quantized = self.cache.config.quantized
         self.draft_cache = None
         self._draft_quantized = False
@@ -467,6 +476,10 @@ class ServingEngine:
         self.read_page_step = jax.jit(self._read_page_impl)
         self.write_page_step = jax.jit(self._write_page_impl,
                                        donate_argnums=(0,))
+        # HBM->host spill tier (ISSUE 20): the cache calls back through
+        # the SAME warmed ("page_read",) signature when it pages a cold
+        # published page out, so spill traffic compiles nothing
+        self.cache.attach_spill_io(self._spill_read)
         # finished-request store for result(); pop-on-read + bounded, so
         # a server that only consumes step()'s return dict still cannot
         # grow host memory with the total requests ever served
@@ -648,6 +661,11 @@ class ServingEngine:
             # disaggregation tier: the two-tier router and the
             # autoscaler key placement/scaling decisions off this
             "tier": self.tier,
+            # hierarchical KV (ISSUE 20): bumps on ANY publication
+            # change in EITHER tier (device index or host spill pool),
+            # so fleet affinity snapshots can detect a replica that
+            # dropped a prefix it used to advertise
+            "prefix_gen": int(self.cache.prefix_gen),
         }
         if self.slo_monitor is not None:
             h["slo"] = self.slo_monitor.status()
@@ -692,11 +710,33 @@ class ServingEngine:
             "prefix_saved_per_token": round(
                 saved / tokens if tokens else 0.0, 6),
         }
+        # host spill tier: headroom 1.0 when the tier is off (it can
+        # never veto anything), else spare host-pool capacity — the
+        # autoscaler's scale-in veto reads this so a fleet does not
+        # shrink away the replica holding everyone's cold prefixes
+        pool = self.cache.spill_pool
+        if pool is None:
+            head["spill"] = 1.0
+            head["spill_pages"] = 0
+            head["spill_bytes"] = 0
+        else:
+            head["spill"] = round(
+                max(1.0 - len(pool) / pool.capacity, 0.0), 6)
+            head["spill_pages"] = len(pool)
+            head["spill_bytes"] = int(pool.spilled_bytes())
         g = self._reg.gauge(
             "serving_headroom",
             "spare capacity per resource (1 = idle, 0 = saturated)")
-        for res in ("flops", "pages", "slots", "hbm"):
+        for res in ("flops", "pages", "slots", "hbm", "spill"):
             g.set(head[res], resource=res)
+        self._reg.gauge(
+            "serving_spill_pages",
+            "published KV pages resident in the host spill pool"
+        ).set(head["spill_pages"])
+        self._reg.gauge(
+            "serving_spill_bytes",
+            "bytes of KV (incl. int8 scale rows) in the host spill pool"
+        ).set(head["spill_bytes"])
         self._reg.gauge(
             "serving_flops_utilization",
             "retired static flops per busy second / best observed rate"
@@ -1096,10 +1136,73 @@ class ServingEngine:
 
     # -- prefill ----------------------------------------------------------
 
+    def _spill_read(self, pid: int):
+        """Cache spill callback: read one page to host through the
+        warmed ``("page_read",)`` signature. Returns the host arrays
+        the spill pool stores — ``(kv,)`` or ``(kv, scales)`` when
+        quantized, so int8 scale rows always travel with their page."""
+        page = self.read_page_step(self.cache.pages,
+                                   jnp.asarray(pid, jnp.int32))
+        if self.quantized:
+            return (np.asarray(page[0]), np.asarray(page[1]))
+        return (np.asarray(page),)
+
+    def _restore_spilled(self, prompt, rid: int) -> int:
+        """Admission-overlapped restore (the DeviceEmbeddingCache
+        ``pull_async`` pattern): before reserving pages for ``prompt``,
+        pull any host-spilled pages of its published chain back to the
+        device so ``reserve`` maps them as ordinary shared-prefix hits.
+        All ``device_put`` transfers start first (async, overlapping
+        each other and this thread's bookkeeping), then each page is
+        adopted + written through the warmed ``("page_write",)``
+        signature — zero compiles, zero new shapes. A payload whose
+        sha256 no longer matches is dropped and the chain walk stops
+        there: a corrupt page must cause a re-prefill, never a
+        corrupt hit."""
+        pool = self.cache.spill_pool
+        if pool is None:
+            return 0
+        plan = self.cache.spill_restore_plan(prompt)
+        if not plan:
+            return 0
+        entries, devs = [], []
+        for ent in plan:
+            if payload_digest(ent.payload) != ent.sha256:
+                pool.pop(ent.key)
+                self._reg.counter(
+                    "serving_spill_corrupt_total",
+                    "host-spilled pages refused on restore "
+                    "(sha256 mismatch)").inc()
+                break
+            entries.append(ent)
+            devs.append(tuple(jax.device_put(a) for a in ent.payload))
+        nbytes = 0
+        for ent, dv in zip(entries, devs):
+            pid = self.cache.adopt_published_page(ent.key, ent.tokens)
+            self.cache.pages = self.write_page_step(
+                self.cache.pages, jnp.asarray(pid, jnp.int32), *dv)
+            nbytes += ent.nbytes
+        if entries:
+            pool.note_restored(len(entries), nbytes)
+            self._reg.counter(
+                "serving_spill_restored_pages_total",
+                "host-spilled pages restored to HBM on a prefix hit"
+            ).inc(len(entries))
+            self._reg.counter(
+                "serving_spill_restored_bytes_total",
+                "bytes restored from the host spill pool"
+            ).inc(nbytes)
+            root = self._req_spans.get(rid)
+            if root is not None:
+                root.add_event("spill_restored", pages=len(entries),
+                               bytes=nbytes)
+        return len(entries)
+
     def _on_admit(self, slot: int, req):
         """Admission callback: reserve pages (mapping any published
         shared prefix), seed the slot's prefill cursor past the shared
         tokens, and record the queue-wait half of the TTFT split."""
+        self._restore_spilled(req.prompt, req.rid)
         shared = self.cache.reserve(slot, req.total_tokens,
                                     prompt=req.prompt)
         if self.speculative:
@@ -1860,6 +1963,177 @@ class ServingEngine:
                           "in-flight requests migrated in").inc()
         self._refresh_health()
         return rid
+
+    # -- fleet-global prefix reuse (ISSUE 20) ------------------------------
+
+    def export_prefix_pages(self, digests) -> Optional[Dict[str, object]]:
+        """Package the leading run of ``digests`` this engine still
+        holds — device-published OR host-spilled — as a prefix-page
+        bundle a peer can :meth:`import_prefix_pages`. Each page ships
+        its chain key, its token content, and per-(page, tp-shard)
+        sha256 shards (the slot-migration layout, so int8 scale rows
+        travel inside the shard hash). Stops at the first digest this
+        cache no longer holds: later pages could not chain onto a
+        missing parent on the importer anyway. Returns None when
+        nothing is exportable — the router degrades to re-prefill."""
+        if not self.cache.config.share_prefix:
+            return None
+        cfgc = self.cache.config
+        hl = self._tp_heads
+        tp_shards = self.tp if self.tp_spmd else 1
+        pages, total_bytes = [], 0
+        for key in digests:
+            key = int(key)
+            hit = self.cache.lookup_prefix_page(key)
+            if hit is None:
+                break
+            if hit[0] == "device":
+                _, pid, tokens = hit
+                page = self.read_page_step(self.cache.pages,
+                                           jnp.asarray(pid, jnp.int32))
+                if self.quantized:
+                    kv_all = np.asarray(page[0])
+                    sc_all = np.asarray(page[1])
+                else:
+                    kv_all, sc_all = np.asarray(page), None
+            else:
+                ent = hit[1]
+                if payload_digest(ent.payload) != ent.sha256:
+                    # a rotted host copy must never leave this replica;
+                    # drop it so the advertisement goes stale too
+                    self.cache.spill_pool.pop(ent.key)
+                    self._reg.counter(
+                        "serving_spill_corrupt_total",
+                        "host-spilled pages refused on restore "
+                        "(sha256 mismatch)").inc()
+                    break
+                tokens = ent.tokens
+                kv_all = ent.payload[0]
+                sc_all = ent.payload[1] if self.quantized else None
+            shards, manifest = [], []
+            for t in range(tp_shards):
+                kv_t = kv_all[..., t * hl:(t + 1) * hl, :]
+                shard = (kv_t, sc_all) if self.quantized else kv_t
+                shards.append(shard)
+                manifest.append({
+                    "index": len(pages),
+                    "tp_shard": t,
+                    "sha256": self._shard_digest(shard),
+                    "bytes": self._shard_bytes(shard),
+                })
+                total_bytes += manifest[-1]["bytes"]
+            pages.append({"key": key,
+                          "tokens": np.asarray(tokens, np.int32),
+                          "shards": shards, "manifest": manifest})
+        if not pages:
+            return None
+        self._reg.counter(
+            "serving_prefix_exported_pages_total",
+            "published prefix pages exported to fleet peers"
+        ).inc(len(pages))
+        return {
+            "format": PREFIX_BUNDLE_FORMAT,
+            "geometry": {"num_layers": cfgc.num_layers,
+                         "num_heads": cfgc.num_heads,
+                         "head_dim": cfgc.head_dim,
+                         "page_size": cfgc.page_size,
+                         "dtype": str(jnp.dtype(cfgc.dtype)),
+                         "tp": tp_shards},
+            "pages": pages,
+            "bytes": int(total_bytes),
+        }
+
+    def import_prefix_pages(self, bundle) -> int:
+        """Install a peer's :meth:`export_prefix_pages` bundle into the
+        published-prefix index so the NEXT admission maps the pages as
+        ordinary shared-prefix hits instead of re-prefilling. The whole
+        bundle is verified before any page lands: format, cache
+        geometry, the full publication hash chain from the root (each
+        page's key must equal ``chain(parent, tokens)`` — a bundle
+        claiming pages it cannot prove is refused), and every shard's
+        sha256. Pages land all-or-nothing into idle free pages only
+        (never evicting), through the warmed ``("page_write",)``
+        signature. Returns pages installed (0 when everything was
+        already held — not an error)."""
+        if bundle is None or not self.cache.config.share_prefix:
+            return 0
+        if bundle.get("format") != PREFIX_BUNDLE_FORMAT:
+            raise SlotMigrationError(
+                f"unknown prefix bundle format {bundle.get('format')!r}")
+        cfgc = self.cache.config
+        tp_shards = self.tp if self.tp_spmd else 1
+        mine = {"num_layers": cfgc.num_layers, "num_heads": cfgc.num_heads,
+                "head_dim": cfgc.head_dim, "page_size": cfgc.page_size,
+                "dtype": str(jnp.dtype(cfgc.dtype)),
+                "tp": tp_shards}
+        if bundle.get("geometry") != mine:
+            raise SlotMigrationError(
+                f"cache geometry mismatch: bundle "
+                f"{bundle.get('geometry')} != engine {mine}")
+        pages = bundle.get("pages") or []
+        prev = _ROOT_KEY
+        for page in pages:
+            tokens = np.asarray(page["tokens"], np.int32).reshape(-1)
+            if tokens.shape[0] != cfgc.page_size:
+                raise SlotMigrationError(
+                    f"prefix page carries {tokens.shape[0]} tokens "
+                    f"(page_size {cfgc.page_size}) — refusing")
+            key = int(page["key"])
+            if _chain(prev, tokens) != key:
+                raise SlotMigrationError(
+                    "prefix bundle breaks the publication hash chain "
+                    "— refusing to install unprovable pages")
+            prev = key
+            shards, manifest = page["shards"], page["manifest"]
+            if len(shards) != tp_shards or len(manifest) != tp_shards:
+                raise SlotMigrationError(
+                    f"{len(shards)} shards for a {tp_shards}-shard "
+                    "page — refusing")
+            for shard, rec in zip(shards, manifest):
+                digest = self._shard_digest(shard)
+                if digest != rec["sha256"]:
+                    raise SlotMigrationError(
+                        f"prefix shard sha256 mismatch ({digest[:12]}… "
+                        f"!= {rec['sha256'][:12]}…) — refusing to "
+                        "install a corrupt page")
+        held = self.cache.advertised_digests()
+        install = [p for p in pages if int(p["key"]) not in held]
+        if not install:
+            return 0
+        if len(install) > self.cache.idle_free_pages:
+            # all-or-nothing, and never by eviction: installing a
+            # remote prefix must not destroy local published pages
+            raise SlotMigrationError(
+                f"no idle page capacity for {len(install)} fetched "
+                "prefix pages")
+        nbytes = 0
+        for page in install:
+            pid = self.cache.adopt_published_page(
+                int(page["key"]), page["tokens"])
+            chunks = page["shards"]
+            if self.quantized:
+                kv = np.concatenate([np.asarray(c[0]) for c in chunks],
+                                    axis=3)
+                sc = chunks[0][1]
+                self.cache.pages = self.write_page_step(
+                    self.cache.pages, jnp.asarray(pid, jnp.int32),
+                    jnp.asarray(kv), jnp.asarray(sc))
+            else:
+                kv = np.concatenate([np.asarray(c) for c in chunks],
+                                    axis=3)
+                self.cache.pages = self.write_page_step(
+                    self.cache.pages, jnp.asarray(pid, jnp.int32),
+                    jnp.asarray(kv))
+            nbytes += sum(int(r["bytes"]) for r in page["manifest"])
+        self._reg.counter(
+            "serving_prefix_fetched_pages_total",
+            "prefix pages installed from fleet peers").inc(len(install))
+        self._reg.counter(
+            "serving_prefix_fetched_bytes_total",
+            "bytes of prefix pages installed from fleet peers"
+        ).inc(nbytes)
+        self._refresh_health()
+        return len(install)
 
     # -- tensor parallel helpers ------------------------------------------
 

@@ -162,7 +162,11 @@ class FleetAutoscaler:
         # plane pass regardless.
         for rh in h["per_replica"].values():
             head = rh.get("headroom") or {}
-            for res in ("pages", "slots", "hbm"):
+            # "spill" joins the veto (ISSUE 20): a replica whose host
+            # pool is full of spilled prefix pages is the fleet's cold
+            # prefix store — scaling it in would destroy pages peers
+            # still fetch (spill reads 1.0 when the tier is off)
+            for res in ("pages", "slots", "hbm", "spill"):
                 if float(head.get(res, 1.0)) < self.headroom_floor:
                     return False
         return True

@@ -79,6 +79,7 @@ class ChaosSpec:
     crash_on_snapshot: bool = False         # dies mid-drain
     crash_on_handoff: bool = False          # prefill dies mid-handoff
     crash_on_restore: bool = False          # decode dies mid-restore
+    crash_on_export: bool = False           # dies mid-prefix-page-fetch
 
 
 def chaos_schedule(seed: int, n_replicas: int, *,
@@ -198,6 +199,18 @@ class ChaosReplica:
     def prefix_digests(self):
         self._check()
         return self.inner.prefix_digests()
+
+    def export_prefix_pages(self, digests):
+        self._check()
+        if self.spec.crash_on_export:
+            self.dead = True
+            raise ReplicaCrashed(
+                f"chaos: {self.name} crashed mid-prefix-export")
+        return self.inner.export_prefix_pages(digests)
+
+    def import_prefix_pages(self, bundle):
+        self._check()
+        return self.inner.import_prefix_pages(bundle)
 
     def can_accept(self, total_tokens):
         self._check()

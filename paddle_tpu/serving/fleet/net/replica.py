@@ -236,6 +236,13 @@ class NetReplica(ReplicaHandle):
         # so it does not cross the wire
         return int(self._call("restore", {"snap": snap}))
 
+    def export_prefix_pages(self, digests) -> Optional[Dict]:
+        return self._call("export_prefix_pages",
+                          {"digests": [int(d) for d in digests]})
+
+    def import_prefix_pages(self, bundle) -> int:
+        return int(self._call("import_prefix_pages", {"bundle": bundle}))
+
     def warmup(self):
         # warmup compiles every (bucket, batch) shape — minutes on a
         # real accelerator, so it gets its own generous deadline

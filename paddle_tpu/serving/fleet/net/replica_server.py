@@ -248,6 +248,11 @@ class ReplicaServer:
             return rep.snapshot_inflight()
         if op == "restore":
             return rep.restore(a["snap"])
+        if op == "export_prefix_pages":
+            return rep.export_prefix_pages(
+                [int(d) for d in a.get("digests", ())])
+        if op == "import_prefix_pages":
+            return rep.import_prefix_pages(a.get("bundle"))
         if op == "warmup":
             rep.warmup()
             return True

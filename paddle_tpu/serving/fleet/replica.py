@@ -149,6 +149,20 @@ class ReplicaHandle:
     def restore(self, snap: Dict, *, parent_span=None) -> int:
         raise NotImplementedError
 
+    def export_prefix_pages(self, digests) -> Optional[Dict]:
+        """Package the leading run of ``digests`` this replica holds as
+        a prefix-page bundle (hash-chained, per-(page, tp-shard) sha256
+        shards) for a peer's :meth:`import_prefix_pages`. Transports
+        without page export return None — the router degrades to local
+        re-prefill, never an error."""
+        return None
+
+    def import_prefix_pages(self, bundle) -> int:
+        """Install a peer's exported prefix pages into this replica's
+        published index (verified all-or-nothing). Returns pages
+        installed; transports without page import install nothing."""
+        return 0
+
     def warmup(self):
         raise NotImplementedError
 
@@ -254,9 +268,11 @@ class LocalReplica(ReplicaHandle):
 
     def prefix_digests(self) -> frozenset:
         with self._lock:
-            # published_digests walks the cache's digest map, which
-            # step()'s page commits mutate — same race as result()
-            return self.engine.cache.published_digests()
+            # advertised_digests walks the cache's digest map AND the
+            # host spill pool, which step()'s page commits mutate —
+            # same race as result(). Spilled pages count: they restore
+            # on the next local hit and export to fetching peers
+            return self.engine.cache.advertised_digests()
 
     def can_accept(self, total_tokens: int) -> bool:
         return (not self.draining
@@ -350,6 +366,14 @@ class LocalReplica(ReplicaHandle):
     def restore(self, snap: Dict, *, parent_span=None) -> int:
         with self._lock:
             return self.engine.restore_slot(snap, parent_span=parent_span)
+
+    def export_prefix_pages(self, digests) -> Optional[Dict]:
+        with self._lock:
+            return self.engine.export_prefix_pages(digests)
+
+    def import_prefix_pages(self, bundle) -> int:
+        with self._lock:
+            return self.engine.import_prefix_pages(bundle)
 
     # -- threaded mode -----------------------------------------------------
     def start(self, idle_sleep_s: float = 0.001) -> "LocalReplica":

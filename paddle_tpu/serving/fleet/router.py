@@ -95,6 +95,10 @@ class _FleetRequest:
     committed: List[int] = dataclasses.field(default_factory=list)
     observed: List[int] = dataclasses.field(default_factory=list)
     checkpoint: Optional[Dict] = None
+    # leading prefix pages the routed replica advertised at submit time
+    # — compared against the replica's actual shared_tokens at finish to
+    # catch stale affinity views (fleet_affinity_miss_total)
+    affinity_pages: int = 0
 
 
 @guarded_by("_view_lock", "_postmortems", "_tiers")
@@ -115,13 +119,18 @@ class FleetRouter:
                  autoscaler=None, faults: Optional[FaultPolicy] = None,
                  clock=time.monotonic,
                  postmortem_dir: Optional[str] = None,
-                 shed_spike_threshold: int = 4):
+                 shed_spike_threshold: int = 4,
+                 prefix_fetch: bool = True):
         if not replicas:
             raise ValueError("need at least one replica")
         if policy not in ("affinity", "p2c", "round_robin"):
             raise ValueError(f"unknown policy {policy!r}")
         self.replicas: List = list(replicas)
         self.policy = policy
+        # fleet-global prefix reuse (ISSUE 20): when the routed replica
+        # misses prefix pages a peer advertises, pull the committed
+        # pages from the holder instead of re-prefilling
+        self.prefix_fetch = bool(prefix_fetch)
         from paddle_tpu import observability as obs
         self._reg = registry or obs.default()
         self.tracer = tracer or obs.tracing.default()
@@ -450,13 +459,15 @@ class FleetRouter:
             if span is not None and span.end is None:
                 span.finish(status="error")
             raise
+        fetched = self._prefix_fetch(rep, hits, prompt, trace_id)
         frid = next(self._frids)
         self._where[frid] = (rep, lrid)
         self._rev[(id(rep), lrid)] = frid
         self._reqs[frid] = _FleetRequest(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             lane=lane, ttft_deadline_s=ttft_deadline_s,
-            submitted_at=self._clock(), trace_id=trace_id)
+            submitted_at=self._clock(), trace_id=trace_id,
+            affinity_pages=hits + fetched)
         if trace_id:
             self._trace[frid] = trace_id
         if span is not None:
@@ -475,6 +486,132 @@ class FleetRouter:
                 "fleet_affinity_routed_total",
                 "requests placed by prefix affinity").inc()
         return frid
+
+    def _prefix_fetch(self, target, hits: int, prompt,
+                      trace_id: int = 0) -> int:
+        """Fleet-global prefix reuse (ISSUE 20): when the routed
+        replica misses leading prefix pages a peer advertises, pull the
+        committed pages from the holder as hash-chained migration
+        shards and install them on the target BEFORE its next admission
+        — re-use instead of re-prefill. Strictly best-effort: a holder
+        that drained, crashed, or got autoscaled away mid-fetch, and a
+        bundle the importer refuses, all degrade to local re-prefill
+        with a structured marker. The request itself is never touched.
+        Returns pages installed on the target."""
+        if not self.prefix_fetch:
+            return 0
+        try:
+            digests = prompt_prefix_digests(prompt, target.page_size())
+        except TRANSPORT_ERRORS:
+            return 0
+        if not digests:
+            return 0
+        holders = []
+        for r in self.replicas:
+            if r is target:
+                continue
+            # draining replicas stay candidates: a drain refuses NEW
+            # work, but exporting committed pages is a read — exactly
+            # the window where a drained replica's prefixes must
+            # survive by copying out
+            try:
+                held = r.prefix_digests()
+            except NotImplementedError:
+                raise
+            except Exception:
+                continue        # unreachable holder: not a candidate
+            run = 0
+            for d in digests:   # leading run only, like _route
+                if d not in held:
+                    break
+                run += 1
+            if run > hits:
+                holders.append((run, r))
+        if not holders:
+            return 0
+        holders.sort(key=lambda t: -t[0])
+        t0 = self._clock()
+        for run, holder in holders:
+            try:
+                bundle = holder.export_prefix_pages(digests[:run])
+            except TRANSPORT_ERRORS as e:
+                # the holder died/drained mid-fetch: breaker + detector
+                # see it like any transport failure, next holder serves
+                if self.faults.enabled:
+                    self._note_transport_failure(holder, e, trace_id)
+                self._reg.counter(
+                    "fleet_prefix_fetch_failed_total",
+                    "prefix-page fetches failed before install").inc(
+                        reason="transport")
+                continue
+            if bundle is None:
+                # stale advertisement: the pages left the holder
+                # between the scan and the export
+                self._reg.counter(
+                    "fleet_prefix_fetch_failed_total",
+                    "prefix-page fetches failed before install").inc(
+                        reason="gone")
+                continue
+            self._note_transport_success(holder, trace_id)
+            try:
+                installed = target.import_prefix_pages(bundle)
+            except SlotMigrationError as e:
+                # corrupt or unprovable bundle REFUSED by the importer
+                # — never installed, never decoded from
+                self._reg.counter(
+                    "fleet_prefix_fetch_refused_total",
+                    "prefix bundles refused by the importer "
+                    "(corrupt or incompatible)").inc()
+                self._degrade_prefix_fetch(target, holder, trace_id,
+                                           str(e))
+                return 0
+            except TRANSPORT_ERRORS as e:
+                # the TARGET failed mid-install: the request's own
+                # redrive machinery owns that failure, not the fetch
+                if self.faults.enabled:
+                    self._note_transport_failure(target, e, trace_id)
+                self._degrade_prefix_fetch(target, holder, trace_id,
+                                           type(e).__name__)
+                return 0
+            if installed:
+                self._reg.counter(
+                    "fleet_prefix_fetch_total",
+                    "prefix-page fetch transfers completed").inc(
+                        src=holder.name, dst=target.name)
+                self._reg.counter(
+                    "fleet_prefix_fetch_pages_total",
+                    "prefix pages installed from fleet peers").inc(
+                        installed)
+                self._reg.counter(
+                    "fleet_prefix_fetch_bytes_total",
+                    "prefix-page bytes shipped between replicas").inc(
+                        int(bundle.get("bytes") or 0))
+                if self.tracer.enabled:
+                    self.tracer.record_span(
+                        "router.prefix_fetch",
+                        duration_s=self._clock() - t0,
+                        trace_id=trace_id or None, src=holder.name,
+                        dst=target.name, pages=installed,
+                        status="fetched")
+            return installed
+        self._degrade_prefix_fetch(target, None, trace_id,
+                                   "no holder reachable")
+        return 0
+
+    def _degrade_prefix_fetch(self, target, holder, trace_id: int,
+                              reason: str):
+        """Structured degrade marker: the fetch failed, the request
+        re-prefills locally — visible as a counter and a span, never an
+        error on the request."""
+        self._reg.counter(
+            "fleet_prefix_fetch_degraded_total",
+            "prefix fetches degraded to local re-prefill").inc()
+        if self.tracer.enabled:
+            self.tracer.record_span(
+                "router.prefix_fetch", duration_s=0.0,
+                trace_id=trace_id or None, dst=target.name,
+                src=holder.name if holder is not None else "",
+                status="degraded_local_prefill", reason=reason)
 
     def _note_transport_failure(self, rep, exc, trace_id: int = 0):
         """Breaker + detector accounting for a transport-shaped
@@ -785,6 +922,16 @@ class FleetRouter:
             if rec is not None and rec.redrives:
                 st["redrives"] = rec.redrives
             self._stats[frid] = st
+            if rec is not None and rec.affinity_pages \
+                    and not rec.redrives \
+                    and not float(st.get("shared_tokens") or 0.0):
+                # stale affinity view (ISSUE 20): routing promised
+                # shared pages the replica no longer held at admission
+                # — prefix_gen propagation should keep this at zero
+                self._reg.counter(
+                    "fleet_affinity_miss_total",
+                    "affinity-routed requests that mapped no shared "
+                    "pages on arrival").inc()
         rep.result(lrid)                      # drop the replica's copy
         self._results[frid] = toks
         while len(self._results) > self._results_cap:
@@ -1433,7 +1580,7 @@ class FleetMonitor:
             # the fleet-level bottleneck (min across replicas) the
             # autoscaler and /healthz read
             for res, v in (rh.get("headroom") or {}).items():
-                if res in ("flops", "pages", "slots", "hbm"):
+                if res in ("flops", "pages", "slots", "hbm", "spill"):
                     v = float(v)
                     g("fleet_replica_headroom",
                       "per-replica resource headroom "

@@ -72,12 +72,16 @@ page *contents* are sharded.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from paddle_tpu.analysis.concurrency import guarded_by
 
 
 @dataclasses.dataclass
@@ -195,6 +199,127 @@ def prompt_prefix_digests(prompt, page_size: int) -> List[int]:
     return [key for _p, key, _c in _chain_walk(prompt, page_size, limit)]
 
 
+def payload_digest(payload: Tuple[np.ndarray, ...]) -> str:
+    """sha256 over a spilled page's host arrays — the int8 KV and its
+    fp32 scale rows hash as ONE digest (a scale-only corruption must be
+    refused exactly like a KV corruption)."""
+    h = hashlib.sha256()
+    for a in payload:
+        h.update(np.asarray(a).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class SpilledPage:
+    """One published full page parked in host memory: its hash-chain
+    key, the stored token content (match verification stays
+    content-checked, never hash-only), the host copies of the page's
+    device arrays (``(kv,)`` fp, ``(kv, scales)`` int8 — scale rows
+    always travel WITH their page), and the sha256 stamped at spill
+    time that restore/export re-verify."""
+
+    key: int
+    tokens: np.ndarray
+    payload: Tuple[np.ndarray, ...]
+    sha256: str
+    nbytes: int
+
+
+@guarded_by("_lock", "_entries")
+class HostPagePool:
+    """Host-memory LRU tier for spilled KV pages (ISSUE 20).
+
+    When the device cached pool would evict (and destroy) a published
+    page under allocator pressure, the page's bytes land here instead,
+    keyed by its prefix-chain digest; the next prefix hit restores it
+    with an async ``device_put`` that overlaps admission, and a fleet
+    peer fetch can export straight from here without touching HBM.
+    Bounded in pages — over ``capacity`` the LRU entry is dropped (the
+    only path that truly destroys a published page's content now).
+
+    ``gen`` bumps on EVERY mutation (spill, restore, drop, discard):
+    together with the device index's ``_index_gen`` it forms
+    :attr:`PagedKVCache.prefix_gen`, the generation the fleet's
+    affinity snapshots key on — a silently-dropped prefix must change
+    the advertised digest set, never linger in a stale memo.
+
+    Thread-safe (one ``threading.Lock``, a leaf in the committed lock
+    order): the engine mutates it from the step thread while a fleet
+    router thread reads ``keys()``/``len()`` through
+    ``advertised_digests``/``health``.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError("HostPagePool needs capacity >= 1")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, SpilledPage]" = OrderedDict()
+        self.gen = 0
+        self.spilled_total = 0
+        self.restored_total = 0
+        self.dropped_total = 0
+        self.spilled_bytes_total = 0
+        self.restored_bytes_total = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._entries)
+
+    def entries(self) -> List[SpilledPage]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def spilled_bytes(self) -> int:
+        """Host bytes resident right now."""
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def put(self, entry: SpilledPage):
+        """Admit one spilled page (newest = most recently used); LRU
+        entries past capacity are dropped and counted."""
+        with self._lock:
+            self._entries[entry.key] = entry
+            self._entries.move_to_end(entry.key)
+            self.gen += 1
+            self.spilled_total += 1
+            self.spilled_bytes_total += entry.nbytes
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.dropped_total += 1
+                self.gen += 1
+
+    def get(self, key: int) -> Optional[SpilledPage]:
+        """Peek (and LRU-touch) without removing."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+            return ent
+
+    def pop(self, key: int) -> Optional[SpilledPage]:
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is not None:
+                self.gen += 1
+            return ent
+
+    def discard(self, key: int):
+        """Drop an entry that became device-resident again (restore,
+        peer fetch, or a fresh local publication of the same chain) —
+        the pool holds COLD pages only, never a device duplicate."""
+        self.pop(key)
+
+    def note_restored(self, pages: int, nbytes: int):
+        with self._lock:
+            self.restored_total += pages
+            self.restored_bytes_total += nbytes
+
+
 class PagedKVCache:
     """Device pages + host-side page allocator, block tables, and the
     refcounted prefix-sharing index.
@@ -204,7 +329,8 @@ class PagedKVCache:
     while int8 scale rows stay replicated (per-token scales are
     head-global). Allocator/index state is host-side and unaffected."""
 
-    def __init__(self, config: PagedCacheConfig, mesh=None):
+    def __init__(self, config: PagedCacheConfig, mesh=None,
+                 host_spill_pages: int = 0):
         self.config = config
         self.mesh = mesh if (mesh is not None
                              and int(mesh.shape.get("tp", 1)) > 1) else None
@@ -270,6 +396,19 @@ class PagedKVCache:
         self._digests_gen = -1
         self.shared_tokens_total = 0     # prefill tokens skipped via sharing
         self.cow_copies_total = 0
+        # HBM -> host spill tier (ISSUE 20), off by default (0 pages):
+        # _alloc_page pages evicted published pages into the host pool
+        # instead of destroying them, via the engine-installed reader
+        # (attach_spill_io) so page bytes leave the device through the
+        # warmed ("page_read",) signature
+        self.spill_pool: Optional[HostPagePool] = (
+            HostPagePool(host_spill_pages) if host_spill_pages > 0
+            else None)
+        self._spill_reader: Optional[Callable] = None
+        # advertised_digests() memo: device index keys + spilled keys,
+        # keyed on prefix_gen (either tier changing invalidates it)
+        self._adv_digests = frozenset()
+        self._adv_gen = -1
 
     # -- allocator --------------------------------------------------------
 
@@ -312,9 +451,35 @@ class PagedKVCache:
             return self._free.pop()
         if self._cached:     # evict the LRU published-but-idle page
             pid, _ = self._cached.popitem(last=False)
+            self._spill_page(pid)
             self._unpublish(pid)
             return pid
         raise PageOverflowError("page pool exhausted")
+
+    def attach_spill_io(self, reader: Callable):
+        """Install the engine's page reader (``pid -> tuple of host
+        arrays``, the full stacked page the jitted ``read_page_step``
+        returns). Spilling stays a no-op until both a pool AND a reader
+        exist, so a bare cache (unit tests, draft caches) never tries
+        device IO."""
+        self._spill_reader = reader
+
+    def _spill_page(self, pid: int):
+        """Page an evicted published FULL page out to the host pool
+        (kv + scale rows together, sha256-stamped) instead of letting
+        ``_unpublish`` destroy its content. Tail pages are not spilled:
+        they are at most ``page_size - 1`` tokens of recompute and do
+        not participate in fleet digests."""
+        if self.spill_pool is None or self._spill_reader is None:
+            return
+        pub = self._page_pub.get(pid)
+        if pub is None or pub[0] != "full":
+            return
+        payload = tuple(np.asarray(a) for a in self._spill_reader(pid))
+        self.spill_pool.put(SpilledPage(
+            key=pub[1], tokens=self._page_tokens[pid].copy(),
+            payload=payload, sha256=payload_digest(payload),
+            nbytes=sum(int(a.nbytes) for a in payload)))
 
     def _acquire(self, pid: int):
         """Take a reference on a published page (reviving it from the
@@ -503,6 +668,11 @@ class PagedKVCache:
                 self._page_pub[pid] = ("full", key2)
                 self._page_tokens[pid] = chunk.copy()
                 self._index_gen += 1
+                if self.spill_pool is not None:
+                    # a fresh local prefill re-committed this chain key
+                    # device-side: the cold host copy is now redundant
+                    # (the pool never shadows a device-resident page)
+                    self.spill_pool.discard(key2)
             key, k = key2, p + 1
         self._pub_chain[slot] = key
         if upto >= int(prompt.shape[0]) and upto % ps:
@@ -553,6 +723,105 @@ class PagedKVCache:
             self._digests_gen = self._index_gen
         return self._digests
 
+    # -- HBM -> host spill tier (ISSUE 20) --------------------------------
+
+    @property
+    def prefix_gen(self) -> int:
+        """Monotonic generation over BOTH publication tiers: bumps when
+        the device index changes (publish/unpublish/adopt) AND when the
+        host spill pool changes (spill/restore/drop). A replica
+        publishes this through ``health()`` so fleet affinity snapshots
+        can never keep routing to a replica that silently dropped a
+        prefix — eviction of a published page is a generation change,
+        not a private event."""
+        return self._index_gen + (self.spill_pool.gen
+                                  if self.spill_pool is not None else 0)
+
+    @property
+    def idle_free_pages(self) -> int:
+        """Pages allocatable WITHOUT evicting a published cached page —
+        the budget spill restores and peer-fetch installs spend (taking
+        more would evict-and-respill other cold pages: churn, not
+        progress)."""
+        return len(self._free)
+
+    def advertised_digests(self) -> frozenset:
+        """What this replica advertises fleet-wide: device-published
+        digests plus host-spilled ones — a spilled page is still
+        servable (restored on the next local prefix hit, exported on a
+        peer fetch), so affinity must keep counting it. Memoized on
+        :attr:`prefix_gen`, same discipline as ``published_digests``."""
+        if self.spill_pool is None:
+            return self.published_digests()
+        g = self.prefix_gen
+        if self._adv_gen != g:
+            self._adv_digests = (self.published_digests()
+                                 | self.spill_pool.keys())
+            self._adv_gen = g
+        return self._adv_digests
+
+    def spill_restore_plan(self, prompt) -> List[SpilledPage]:
+        """The spilled full pages that would extend ``prompt``'s
+        device-resident published chain if restored — in chain order,
+        content-verified against the stored tokens like every other
+        match. Walks the same hash chain as ``_match_prefix``; stops at
+        the first page held by NEITHER tier (later pages cannot map —
+        prefix pages only chain onto a present parent). Capped at
+        :attr:`idle_free_pages` so restoring never evicts."""
+        if (self.spill_pool is None or len(self.spill_pool) == 0
+                or prompt is None or not self.config.share_prefix):
+            return []
+        ps = self.config.page_size
+        limit = int(np.asarray(prompt).reshape(-1).shape[0]) - 1
+        plan: List[SpilledPage] = []
+        for _p, key, chunk in _chain_walk(prompt, ps, limit):
+            pid = self._full_index.get(key)
+            if pid is not None:
+                if np.array_equal(self._page_tokens[pid], chunk):
+                    continue
+                break
+            ent = self.spill_pool.get(key)
+            if ent is None or not np.array_equal(ent.tokens, chunk):
+                break
+            plan.append(ent)
+            if len(plan) >= len(self._free):
+                break
+        return plan
+
+    def adopt_published_page(self, key: int, tokens) -> int:
+        """Publish an externally-written page (spill restore or fleet
+        peer fetch): allocate a page, commit it to the full-page index
+        parked in the cached pool (refcount 0 — the next match borrows
+        it exactly like a locally-published page), and drop any host
+        copy of the same key. Returns the page id; the caller owes the
+        device write immediately after (nothing can read the page
+        before the caller's own next cache operation). New adoptions
+        enter the LRU at the hot end, so a same-wave ``_alloc_page``
+        eviction cannot immediately recycle them."""
+        pid = self._alloc_page()
+        self._full_index[key] = pid
+        self._page_pub[pid] = ("full", key)
+        self._page_tokens[pid] = np.asarray(tokens, np.int32).copy()
+        self._cached[pid] = True
+        self._index_gen += 1
+        if self.spill_pool is not None:
+            self.spill_pool.discard(key)
+        return pid
+
+    def lookup_prefix_page(self, key: int):
+        """Resolve one advertised digest for the engine's peer-export
+        path: ``("device", pid, tokens)`` when the page is resident,
+        ``("host", SpilledPage)`` when spilled, None when this cache
+        no longer holds it (dropped under host-pool pressure)."""
+        pid = self._full_index.get(key)
+        if pid is not None:
+            return ("device", pid, self._page_tokens[pid])
+        if self.spill_pool is not None:
+            ent = self.spill_pool.get(key)
+            if ent is not None:
+                return ("host", ent)
+        return None
+
     # -- device views -----------------------------------------------------
 
     def device_tables(self):
@@ -587,3 +856,12 @@ class PagedKVCache:
             assert pid in self._page_tokens, "published page lost tokens"
         for owned, sp in zip(self._owned, self._slot_pages):
             assert owned <= set(sp), "owned page not mapped"
+        if self.spill_pool is not None:
+            spilled = self.spill_pool.keys()
+            assert len(self.spill_pool) <= self.spill_pool.capacity, \
+                "host spill pool over capacity"
+            assert not (spilled & set(self._full_index)), \
+                "page both device-published and host-spilled"
+            for ent in self.spill_pool.entries():
+                assert payload_digest(ent.payload) == ent.sha256, \
+                    "spilled page payload corrupted in host pool"

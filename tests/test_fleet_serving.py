@@ -54,7 +54,7 @@ def _step_until_mid_decode(router, rep, cap, max_steps=1000):
 
 
 def _fleet(model_params, n, tracer=None, policy="affinity", seed=0,
-           autoscaler=None, **kw):
+           autoscaler=None, prefix_fetch=True, **kw):
     tracer = tracer or obs.Tracer(enabled=False)
     reps = [fleet.LocalReplica(_engine(model_params, tracer=tracer, **kw),
                                name=f"r{i}").warmup()
@@ -62,7 +62,8 @@ def _fleet(model_params, n, tracer=None, policy="affinity", seed=0,
     router = fleet.FleetRouter(reps, policy=policy,
                                registry=obs.MetricsRegistry(),
                                tracer=tracer, seed=seed,
-                               autoscaler=autoscaler)
+                               autoscaler=autoscaler,
+                               prefix_fetch=prefix_fetch)
     return router, reps
 
 
@@ -189,7 +190,10 @@ class TestRouting:
     def _run_shared_traffic(self, model_params, policy):
         rng = np.random.default_rng(7)
         sysp = rng.integers(1, VOCAB, 13).astype(np.int32)
-        router, _ = _fleet(model_params, 2, policy=policy, seed=3)
+        # fleet prefix fetch would let round-robin import the pages it
+        # missed — disable it to compare the ROUTING policies alone
+        router, _ = _fleet(model_params, 2, policy=policy, seed=3,
+                           prefix_fetch=False)
         # wave 1 publishes the prefix on ONE replica
         router.submit(_shared_prefix_traffic(rng, sysp, 1)[0], 4)
         router.run_until_idle(max_steps=10_000)

@@ -463,7 +463,8 @@ class TestFleetFlightRecorder:
     def test_headroom_aggregated_and_served(self, crashed_fleet):
         router, mon, reg, *_ = crashed_fleet
         h = mon.collect()
-        assert set(h["headroom"]) == {"flops", "pages", "slots", "hbm"}
+        assert set(h["headroom"]) == {"flops", "pages", "slots", "hbm",
+                                      "spill"}
         assert h["headroom"]["pages"] == 1.0        # fleet is idle now
         g = reg.get("fleet_headroom_min")
         assert g.value(resource="slots") == h["headroom"]["slots"]

@@ -86,6 +86,66 @@ def validate_chaos_section(chaos: dict) -> None:
                          "validation")
 
 
+def validate_prefix_fleet_section(result: dict) -> None:
+    """Schema self-check for BENCH_PREFIX_FLEET.json (ISSUE 20):
+    every key present and correctly typed, and the hierarchical-KV
+    acceptance invariants pinned — fleet prefill tokens per served
+    token strictly below the affinity-only router, greedy parity
+    across the two legs, zero steady-state recompiles, and both the
+    spill tier and the fleet fetch path actually exercised. Raises
+    ValueError with a precise message otherwise."""
+    if not isinstance(result, dict):
+        raise ValueError(f"prefix_fleet result is "
+                         f"{type(result).__name__}, not an object")
+    legs = ("affinity_only", "hierarchical")
+    two_leg = {"prefill_per_served": (int, float),
+               "prefill_tokens": int, "served_tokens": int,
+               "prefix_hit_rate": (int, float),
+               "recompiles_after_warmup": int}
+    for key, t in two_leg.items():
+        sec = result.get(key)
+        if not isinstance(sec, dict):
+            raise ValueError(f"prefix_fleet missing object {key!r}")
+        for leg in legs:
+            if leg not in sec:
+                raise ValueError(f"prefix_fleet[{key!r}] missing "
+                                 f"{leg!r}")
+            if not isinstance(sec[leg], t) or isinstance(sec[leg],
+                                                         bool):
+                raise ValueError(
+                    f"prefix_fleet[{key!r}][{leg!r}] is "
+                    f"{type(sec[leg]).__name__}, want {t}")
+    for key, fields in (("fetch", ("pages", "bytes", "degraded")),
+                        ("spill", ("spilled_pages", "spilled_bytes",
+                                   "restored_pages"))):
+        sec = result.get(key)
+        if not isinstance(sec, dict):
+            raise ValueError(f"prefix_fleet missing object {key!r}")
+        for f in fields:
+            if not isinstance(sec.get(f), int) \
+                    or isinstance(sec.get(f), bool):
+                raise ValueError(f"prefix_fleet[{key!r}][{f!r}] is "
+                                 "missing or not an int")
+    if result.get("greedy_identical") is not True:
+        raise ValueError("prefix_fleet greedy_identical is not true — "
+                         "sharing/fetching changed tokens")
+    rec = result["recompiles_after_warmup"]
+    if rec["affinity_only"] != 0 or rec["hierarchical"] != 0:
+        raise ValueError(f"prefix_fleet recompiled in steady state: "
+                         f"{rec} (must be 0/0)")
+    pps = result["prefill_per_served"]
+    if not result.get("dryrun"):
+        if pps["hierarchical"] >= pps["affinity_only"]:
+            raise ValueError(
+                f"hierarchical prefill/served {pps['hierarchical']} "
+                f"not strictly below affinity-only "
+                f"{pps['affinity_only']}")
+        if result["fetch"]["pages"] <= 0:
+            raise ValueError("prefix_fleet fetch tier never fired")
+        if result["spill"]["spilled_pages"] <= 0:
+            raise ValueError("prefix_fleet spill tier never engaged")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("path", help="JSONL log to validate")

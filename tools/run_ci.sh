@@ -128,8 +128,8 @@ assert r["chaos"]["breaker_cycle_ok"] is True
 assert r["chaos"]["recompiles"] == 0
 # ISSUE 16: the resource-headroom plane (fleet bottleneck, min across
 # replicas) and the crash flight recorder must both ship
-assert set(r["headroom"]) == {"flops", "pages", "slots", "hbm"}, \
-    r["headroom"]
+assert set(r["headroom"]) == {"flops", "pages", "slots", "hbm",
+                              "spill"}, r["headroom"]
 for res, v in r["headroom"].items():
     assert 0.0 <= v <= 1.0, (res, v)
 assert r["chaos"]["postmortems"] >= 1, "no postmortem bundle captured"
@@ -313,6 +313,35 @@ assert r["ttft_ratio"] > 0 and r["throughput_ratio"] > 0
 print("disagg dryrun OK (ttft %.2fx, throughput %.2fx, %d handoffs, "
       "%d transfer bytes)" % (r["ttft_ratio"], r["throughput_ratio"],
                               r["handoffs"], r["transfer_bytes"]))
+'
+
+# hierarchical-KV bench smoke (ISSUE 20): host-spilled cold pages plus
+# fleet-global prefix fetch must run the churn script end-to-end on CPU
+# — wave A publishes + spills, a fresh replica scales out, the holders
+# drain (wave B fetches instead of re-prefilling) and scale in, wave C
+# runs on the survivors — with greedy outputs bit-identical to the
+# affinity-only fleet and zero steady-state recompiles in both legs
+# (schema pinned by check_metrics_log.validate_prefix_fleet_section;
+# the strictly-below prefill/served gate runs non-dryrun in the bench)
+echo "== bench smoke (prefix_fleet dryrun) =="
+PFLEET_OUT="$(python bench.py --model prefix_fleet --dryrun)"
+if echo "$PFLEET_OUT" | grep -q '"error"'; then
+  echo "prefix_fleet bench dryrun failed: $PFLEET_OUT"
+  exit 1
+fi
+echo "$PFLEET_OUT" | python -c '
+import json, sys
+sys.path.insert(0, "tools")
+r = json.load(sys.stdin)
+from check_metrics_log import validate_prefix_fleet_section
+validate_prefix_fleet_section(r)
+assert r["churn"]["scale_out_replicas"] >= 1
+assert r["churn"]["drained_holders"] is True
+pps = r["prefill_per_served"]
+print("prefix_fleet dryrun OK (prefill/served %.3f affinity-only vs "
+      "%.3f hierarchical, %d pages fetched, %d spilled)"
+      % (pps["affinity_only"], pps["hierarchical"],
+         r["fetch"]["pages"], r["spill"]["spilled_pages"]))
 '
 
 # kernel-layer bench smoke: the shared autotuner must measure all three
